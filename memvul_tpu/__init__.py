@@ -1,0 +1,28 @@
+"""memvul_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework with the
+capabilities of the MemVul replication package (FSE 2022, "Automated
+Unearthing of Dangerous Issue Reports").
+
+The reference implementation (PyTorch/AllenNLP) is re-designed TPU-first:
+
+- pure-functional Flax BERT encoder with bf16, layer-scan + remat and a
+  swappable attention kernel (XLA fused / Pallas flash / ring attention);
+- the per-anchor Siamese match loop (reference: model_memory.py:134-147)
+  becomes one einsum against a device-resident anchor bank;
+- scaling via ``jax.sharding.Mesh`` + NamedSharding (data/model axes) with
+  XLA collectives over ICI, instead of torch.distributed/NCCL;
+- a small Registrable-style registry reading the same JSON config shapes
+  as the reference's AllenNLP FromParams system.
+
+Subpackages
+-----------
+``data``      tokenization, normalization, CWE anchors, readers, batching
+``models``    Flax encoders and classification heads
+``ops``       attention kernels (XLA and Pallas)
+``parallel``  mesh/sharding helpers, ring attention
+``training``  trainer loop, optimizers, metrics, callbacks, checkpointing
+``evaluate``  inference pipelines + metric files in the reference format
+"""
+
+__version__ = "0.1.0"
+
+from .registry import Registrable  # noqa: F401

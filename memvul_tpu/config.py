@@ -1,0 +1,106 @@
+"""Config loading with reference-compatible override merging.
+
+The reference drives everything from JSON/Jsonnet configs and, at test
+time, deep-merges a partial override config onto the archived train config
+(reference: predict_memory.py:60-67, test_config_memory.json).  This module
+reproduces that contract: ``load_config`` reads a JSON file (tolerating
+``//`` comments, which the reference's Jsonnet configs use), and
+``merge_overrides`` deep-merges dicts, with dotted keys reaching into
+nested objects.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+def _strip_comments(text: str) -> str:
+    """Drop ``//`` line comments that are outside JSON strings.
+
+    The reference's configs carry trailing comments, e.g.
+    ``"max_length": 512  // different from the data reader``
+    (reference: MemVul/config_no_online.json:89), and ``//`` also appears
+    inside string values (URLs), so a string-aware scan is required.
+    """
+    out = []
+    i, n = 0, len(text)
+    in_string = False
+    while i < n:
+        c = text[i]
+        if in_string:
+            out.append(c)
+            if c == "\\" and i + 1 < n:
+                out.append(text[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                in_string = False
+        elif c == '"':
+            in_string = True
+            out.append(c)
+        elif c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def loads_config(text: str) -> Dict[str, Any]:
+    return json.loads(_strip_comments(text))
+
+
+def load_config(
+    path: Union[str, Path],
+    overrides: Optional[Union[str, Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    cfg = loads_config(Path(path).read_text())
+    if overrides:
+        if isinstance(overrides, str):
+            overrides = loads_config(overrides)
+        cfg = merge_overrides(cfg, overrides)
+    return cfg
+
+
+def merge_overrides(base: Dict[str, Any], overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """Deep-merge ``overrides`` onto ``base`` (returns a new dict).
+
+    A *top-level* dotted key like ``"trainer.optimizer.lr"`` addresses a
+    nested value, matching AllenNLP's override syntax used by the reference
+    eval scripts.  Keys inside nested override dicts are taken literally
+    and deep-merged (the reference's with_fallback semantics).
+    """
+    out = copy.deepcopy(base)
+    for key, value in overrides.items():
+        _assign(out, key.split("."), value)
+    return out
+
+
+def _assign(node: Dict[str, Any], parts: list, value: Any) -> None:
+    key = parts[0]
+    if len(parts) > 1:
+        child = node.setdefault(key, {})
+        if not isinstance(child, dict):
+            child = node[key] = {}
+        _assign(child, parts[1:], value)
+    elif isinstance(value, dict) and isinstance(node.get(key), dict):
+        _deep_merge(node[key], value)
+    else:
+        node[key] = value
+
+
+def _deep_merge(node: Dict[str, Any], overrides: Dict[str, Any]) -> None:
+    for key, value in overrides.items():
+        if isinstance(value, dict) and isinstance(node.get(key), dict):
+            _deep_merge(node[key], value)
+        else:
+            node[key] = value
+
+
+def save_config(cfg: Dict[str, Any], path: Union[str, Path]) -> None:
+    Path(path).write_text(json.dumps(cfg, indent=2, sort_keys=False))

@@ -1,0 +1,1 @@
+from .normalize import normalize_text, replace_tokens_simple  # noqa: F401

@@ -1,0 +1,23 @@
+"""Test configuration: run JAX on CPU with a virtual 8-device mesh.
+
+Must set the environment BEFORE jax is imported anywhere, so this file
+avoids importing jax at module scope until the env vars are in place.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    import jax
+
+    return jax.devices()

@@ -1,0 +1,50 @@
+import json
+
+from memvul_tpu.config import load_config, loads_config, merge_overrides
+
+
+def test_loads_config_strips_comments():
+    cfg = loads_config('{\n// a comment\n"a": 1\n}')
+    assert cfg == {"a": 1}
+
+
+def test_merge_overrides_deep():
+    base = {"model": {"type": "memory", "dropout": 0.1}, "trainer": {"epochs": 30}}
+    out = merge_overrides(base, {"model": {"dropout": 0.2}})
+    assert out["model"] == {"type": "memory", "dropout": 0.2}
+    assert base["model"]["dropout"] == 0.1  # base untouched
+
+
+def test_merge_overrides_dotted_keys():
+    base = {"trainer": {"optimizer": {"lr": 1e-4}}}
+    out = merge_overrides(base, {"trainer.optimizer.lr": 2e-5})
+    assert out["trainer"]["optimizer"]["lr"] == 2e-5
+
+
+def test_merge_overrides_replaces_scalar_with_dict():
+    out = merge_overrides({"a": 1}, {"a.b": 2})
+    assert out == {"a": {"b": 2}}
+
+
+def test_load_config_with_overrides(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"reader": {"max_length": 256}, "batch": 32}))
+    cfg = load_config(p, overrides={"reader.max_length": 512})
+    assert cfg["reader"]["max_length"] == 512
+    assert cfg["batch"] == 32
+
+
+def test_trailing_comments_stripped_but_urls_kept():
+    cfg = loads_config('{"max_length": 512, // trailing comment\n"url": "http://x.org/a"}')
+    assert cfg == {"max_length": 512, "url": "http://x.org/a"}
+
+
+def test_reference_style_config_loads():
+    # trailing-comment style used by the reference's Jsonnet configs
+    cfg = loads_config('{\n"a": 1  // different from the data reader\n}')
+    assert cfg == {"a": 1}
+
+
+def test_nested_override_dict_keys_are_literal():
+    out = merge_overrides({"env": {}}, {"env": {"a.b": 1}})
+    assert out == {"env": {"a.b": 1}}

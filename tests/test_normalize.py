@@ -1,0 +1,138 @@
+from memvul_tpu.data.normalize import normalize_text, replace_tokens_simple
+
+
+def test_non_string_input_returns_empty():
+    assert normalize_text(None) == ""
+    assert normalize_text(123) == ""
+
+
+def test_alias_is_same_function():
+    assert replace_tokens_simple is normalize_text
+
+
+def test_whitespace_collapsed():
+    assert normalize_text("a   b\t c") == "a b c"
+
+
+def test_fenced_code_with_error_becomes_errortag():
+    out = normalize_text("see ```Traceback error: boom``` here")
+    assert "ERRORTAG" in out
+    assert "Traceback" not in out
+
+
+def test_fenced_prose_is_kept():
+    out = normalize_text("x ```simple words here``` y")
+    assert "simple words here" in out
+
+
+def test_fenced_single_token_becomes_apitag():
+    out = normalize_text("call ```do_stuff``` now")
+    assert "APITAG" in out
+
+
+def test_fenced_long_code_becomes_codetag():
+    code = "import os\nfor x in y:\n    foo(x, bar=1) qq\n" * 6
+    out = normalize_text(f"repro: ```{code}```")
+    assert "CODETAG" in out
+
+
+def test_empty_fence_removed():
+    out = normalize_text("a `````` b")
+    assert out == "a b"
+
+
+def test_inline_code_apitag():
+    out = normalize_text("use `do_stuff` ok")
+    assert "APITAG" in out
+
+
+def test_markdown_file_link_becomes_filetag():
+    out = normalize_text("see [report.pdf](http://x.org/report.pdf) ok")
+    assert "FILETAG" in out
+
+
+def test_markdown_plain_link_unwrapped():
+    out = normalize_text("see [here](http://github.com/a/issues/5) ok")
+    assert "here" in out
+    # target URL then hits the URL pass (no file-ish tail)
+    assert "URLTAG" in out or "PATHTAG" in out
+
+
+def test_mitre_links_are_leak_guarded():
+    out = normalize_text("ref https://cwe.mitre.org/data/definitions/79")
+    assert "CVETAG" in out
+    assert "mitre" not in out
+
+
+def test_plain_url_tagged():
+    out = normalize_text("go to http://github.com/octo today")
+    assert "URLTAG" in out
+
+
+def test_cve_and_cwe_ids_are_leak_guarded():
+    out = normalize_text("this fixes CVE-2021-44228 and CWE-79 . ok")
+    assert out.count("CVETAG") == 2
+
+
+def test_email_tagged():
+    out = normalize_text("mail me at bob@gmail.com please")
+    assert "EMAILTAG" in out
+
+
+def test_mention_tagged():
+    out = normalize_text("thanks @octocat for the report")
+    assert "MENTIONTAG" in out
+
+
+def test_exception_name_tagged():
+    out = normalize_text("throws NullPointerException in prod")
+    assert "ERRORTAG" in out
+
+
+def test_path_tagged():
+    out = normalize_text("edit /usr/local/bin/thing to fix")
+    assert "PATHTAG" in out
+
+
+def test_filename_tagged():
+    out = normalize_text("open the config.yml file")
+    assert "FILETAG" in out
+
+
+def test_camelcase_identifier_tagged():
+    out = normalize_text("the parseHeader thing broke")
+    assert "APITAG" in out
+
+
+def test_call_site_tagged():
+    out = normalize_text("invoke setup() first")
+    assert "APITAG" in out
+
+
+def test_version_number_tagged():
+    out = normalize_text("upgrade from 1.2.3 please")
+    assert "NUMBERTAG" in out
+
+
+def test_very_long_token_tagged():
+    out = normalize_text("blob " + "q" * 40 + " end")
+    assert "APITAG" in out
+
+
+def test_hyphens_split():
+    assert normalize_text("well-known fact") == "well known fact"
+
+
+def test_plain_prose_untouched():
+    text = "the server crashes when a user logs in"
+    assert normalize_text(text) == text
+
+
+def test_heading_and_emphasis_markers_removed():
+    out = normalize_text("## Title with **bold** text")
+    assert "#" not in out and "*" not in out
+
+
+def test_html_comment_removed():
+    out = normalize_text("a <!--- hidden ---> b")
+    assert "hidden" not in out

@@ -1,0 +1,105 @@
+import pytest
+
+from memvul_tpu.registry import Registrable, RegistryError
+
+
+class Widget(Registrable):
+    pass
+
+
+@Widget.register("plain")
+class Plain(Widget):
+    def __init__(self, size: int = 1):
+        self.size = size
+
+
+@Widget.register("nested")
+class Nested(Widget):
+    def __init__(self, inner: Widget, name: str):
+        self.inner = inner
+        self.name = name
+
+
+class Gadget(Registrable):
+    pass
+
+
+@Gadget.register("plain")
+class GadgetPlain(Gadget):
+    def __init__(self):
+        pass
+
+
+def test_by_name_and_namespacing():
+    assert Widget.by_name("plain") is Plain
+    assert Gadget.by_name("plain") is GadgetPlain
+
+
+def test_unknown_name_raises():
+    with pytest.raises(RegistryError):
+        Widget.by_name("nope")
+
+
+def test_from_config_flat():
+    w = Widget.from_config({"type": "plain", "size": 3})
+    assert isinstance(w, Plain) and w.size == 3
+
+
+def test_from_config_nested_recursion():
+    w = Widget.from_config(
+        {"type": "nested", "name": "outer", "inner": {"type": "plain", "size": 7}}
+    )
+    assert isinstance(w, Nested)
+    assert isinstance(w.inner, Plain) and w.inner.size == 7
+
+
+def test_from_config_extras_injection():
+    w = Widget.from_config({"type": "nested", "inner": {"type": "plain"}}, name="injected")
+    assert w.name == "injected"
+
+
+def test_missing_required_raises():
+    with pytest.raises(TypeError):
+        Widget.from_config({"type": "nested", "inner": {"type": "plain"}})
+
+
+def test_unexpected_key_raises():
+    with pytest.raises(TypeError):
+        Widget.from_config({"type": "plain", "bogus": 1})
+
+
+def test_duplicate_registration_raises():
+    with pytest.raises(RegistryError):
+
+        @Widget.register("plain")
+        class Other(Widget):
+            pass
+
+
+def test_list_available():
+    assert "plain" in Widget.list_available()
+    assert "nested" in Widget.list_available()
+
+
+def test_pep604_optional_annotation_resolved():
+    @Widget.register("opt", exist_ok=True)
+    class Opt(Widget):
+        def __init__(self, inner: "Widget | None" = None):
+            self.inner = inner
+
+    w = Widget.from_config({"type": "opt", "inner": {"type": "plain", "size": 2}})
+    assert isinstance(w.inner, Plain) and w.inner.size == 2
+
+
+def test_union_prefers_registrable_arm():
+    import typing
+
+    @Widget.register("uni", exist_ok=True)
+    class Uni(Widget):
+        def __init__(self, field: typing.Union[int, Widget] = 0):
+            self.field = field
+
+    w = Widget.from_config({"type": "uni", "field": {"type": "plain", "size": 4}})
+    assert isinstance(w.field, Plain)
+    w2 = Widget.from_config({"type": "uni", "field": 5})
+    assert w2.field == 5
