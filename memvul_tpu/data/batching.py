@@ -1,0 +1,186 @@
+"""Instance streams → fixed-shape device-ready batches.
+
+XLA compiles one program per input shape, so batches must arrive in a
+small closed set of shapes.  This module pads every batch to a fixed
+``batch_size`` (partial tails are padded with dead rows, marked by a
+``weight`` vector) and pads sequences to bucketed lengths.  It also
+memoizes text→ids (CVE descriptions and anchors repeat heavily in the
+pair stream) and can prefetch batches on a background thread so host-side
+tokenization stays off the TPU critical path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+LABELS_SIAMESE = {"same": 0, "diff": 1}
+LABELS_BINARY = {"pos": 0, "neg": 1}
+
+
+class CachedEncoder:
+    """Memoizing wrapper around ``tokenizer.encode``."""
+
+    def __init__(self, tokenizer, max_length: int, cache_size: int = 200_000):
+        self._tokenizer = tokenizer
+        self._max_length = max_length
+        self._cache: Dict[str, List[int]] = {}
+        self._cache_size = cache_size
+
+    @property
+    def pad_id(self) -> int:
+        return self._tokenizer.pad_id
+
+    @property
+    def max_length(self) -> int:
+        return self._max_length
+
+    def __call__(self, text: str) -> List[int]:
+        ids = self._cache.get(text)
+        if ids is None:
+            ids = self._tokenizer.encode(text, max_length=self._max_length)
+            if len(self._cache) < self._cache_size:
+                self._cache[text] = ids
+        return ids
+
+
+def _pad_block(
+    seqs: Sequence[List[int]],
+    batch_size: int,
+    pad_id: int,
+    length: int,
+) -> Dict[str, np.ndarray]:
+    ids = np.full((batch_size, length), pad_id, dtype=np.int32)
+    mask = np.zeros((batch_size, length), dtype=np.int32)
+    for i, seq in enumerate(seqs):
+        seq = seq[:length]
+        ids[i, : len(seq)] = seq
+        mask[i, : len(seq)] = 1
+    return {"input_ids": ids, "attention_mask": mask}
+
+
+def _bucket_length(
+    seqs: Iterable[List[int]], buckets: Optional[Sequence[int]], max_length: int
+) -> int:
+    longest = max((len(s) for s in seqs), default=1)
+    longest = min(longest, max_length)
+    if buckets:
+        return next((b for b in buckets if b >= longest), buckets[-1])
+    return max_length
+
+
+def batches_from_instances(
+    instances: Iterable[Dict],
+    encoder: CachedEncoder,
+    batch_size: int,
+    label_map: Optional[Dict[str, int]] = None,
+    buckets: Optional[Sequence[int]] = None,
+    pad_to_max: bool = False,
+) -> Iterator[Dict]:
+    """Group instances into fixed-shape batches.
+
+    Yields dicts with ``sample1`` (= {input_ids, attention_mask}), and when
+    pairs are present ``sample2``; plus ``label`` [B] int32, ``weight`` [B]
+    float32 (0 for padding rows), and ``meta`` (list, real rows only).
+    """
+    label_map = label_map or LABELS_SIAMESE
+    chunk: List[Dict] = []
+    for inst in instances:
+        chunk.append(inst)
+        if len(chunk) == batch_size:
+            yield _collate(chunk, encoder, batch_size, label_map, buckets, pad_to_max)
+            chunk = []
+    if chunk:
+        yield _collate(chunk, encoder, batch_size, label_map, buckets, pad_to_max)
+
+
+def _collate(
+    chunk: List[Dict],
+    encoder: CachedEncoder,
+    batch_size: int,
+    label_map: Dict[str, int],
+    buckets: Optional[Sequence[int]],
+    pad_to_max: bool,
+) -> Dict:
+    seqs1 = [encoder(inst["text1"]) for inst in chunk]
+    length1 = (
+        encoder.max_length
+        if pad_to_max
+        else _bucket_length(seqs1, buckets, encoder.max_length)
+    )
+    labels = []
+    for inst in chunk:
+        label = inst.get("label")
+        if label not in label_map:
+            raise ValueError(
+                f"label {label!r} not in label map {sorted(label_map)}; "
+                "pass the matching label_map for this reader"
+            )
+        labels.append(label_map[label])
+    batch: Dict = {
+        "sample1": _pad_block(seqs1, batch_size, encoder.pad_id, length1),
+        "label": np.array(
+            labels + [0] * (batch_size - len(chunk)), dtype=np.int32
+        ),
+        "weight": np.array(
+            [1.0] * len(chunk) + [0.0] * (batch_size - len(chunk)), dtype=np.float32
+        ),
+        "meta": [inst.get("meta", {}) for inst in chunk],
+    }
+    if chunk and chunk[0].get("text2") is not None:
+        seqs2 = [encoder(inst["text2"]) for inst in chunk]
+        length2 = (
+            encoder.max_length
+            if pad_to_max
+            else _bucket_length(seqs2, buckets, encoder.max_length)
+        )
+        batch["sample2"] = _pad_block(seqs2, batch_size, encoder.pad_id, length2)
+    return batch
+
+
+def prefetch(iterator: Iterator, depth: int = 4) -> Iterator:
+    """Run ``iterator`` on a background thread with a bounded queue.
+
+    Safe against early consumer exit: closing/abandoning the generator
+    unblocks and stops the worker rather than leaking a thread pinned on a
+    full queue.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+    error: List[BaseException] = []
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker() -> None:
+        try:
+            for item in iterator:
+                if not _put(item):
+                    return
+        except BaseException as e:  # propagate into the consumer
+            error.append(e)
+        finally:
+            _put(_END)
+
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if error:
+                    raise error[0]
+                return
+            yield item
+    finally:
+        stop.set()

@@ -1,0 +1,250 @@
+"""Dataset readers: corpus JSON → instance streams.
+
+Instance = a plain dict:
+  ``text1``        first text (issue report, or anchor description)
+  ``text2``        pair partner text (train mode only)
+  ``label``        classification label string
+  ``meta``         {"type", "label", "Issue_Url"} carried to metric/output
+
+Two readers mirror the reference's:
+
+* :class:`MemoryReader` — Siamese pairs with **online sampling**
+  (reference: MemVul/reader_memory.py).  Each epoch re-rolls: every
+  positive yields one pair with its own CVE description plus ``same-1``
+  pairs with same-CWE partners (partner text: 70% partner's CVE
+  description / 15% anchor / 15% partner report — reference:
+  reader_memory.py:212-224); each negative survives with probability
+  ``sample_neg`` and yields ``diff`` pairs against random anchors.
+
+* :class:`SingleReader` — one instance per report, negatives subsampled
+  during training (reference: MemVul/reader_single.py:106-112).
+
+Mode selection: explicit ``split=`` argument, with the reference's
+path-substring sniffing ("golden"/"test_"/"validation_",
+reference: reader_memory.py:138-162) as fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional
+
+from ..registry import Registrable
+from .normalize import normalize_text
+
+logger = logging.getLogger(__name__)
+
+TRAIN, VALIDATION, TEST, GOLDEN, UNLABEL = (
+    "train", "validation", "test", "golden", "unlabel",
+)
+
+
+def detect_split(file_path: str) -> str:
+    name = str(file_path)
+    if "golden" in name:
+        return GOLDEN
+    if "test_" in name:
+        return TEST
+    if "validation_" in name:
+        return VALIDATION
+    return TRAIN
+
+
+class DatasetReader(Registrable):
+    def read(self, file_path: str, split: Optional[str] = None) -> Iterator[Dict]:
+        raise NotImplementedError
+
+
+@DatasetReader.register("reader_memory")
+class MemoryReader(DatasetReader):
+    def __init__(
+        self,
+        cve_path: Optional[str] = None,
+        anchor_path: Optional[str] = None,
+        same_diff_ratio: Optional[Dict[str, int]] = None,
+        sample_neg: float = 0.1,
+        train_iter: int = 1,
+        target: str = "Security_Issue_Full",
+        seed: Optional[int] = None,
+    ) -> None:
+        self._target = target
+        self._ratio = same_diff_ratio or {"same": 2, "diff": 6}
+        self._sample_neg = sample_neg
+        self._train_iter = train_iter
+        self._rng = random.Random(seed)
+        self._cve: Dict[str, Dict] = {}
+        self._anchors: Dict[str, str] = {}
+        if cve_path:
+            self._cve = json.loads(Path(cve_path).read_text())
+        if anchor_path:
+            self._anchors = json.loads(Path(anchor_path).read_text())
+        self._grouped_cache: Dict[str, Dict[str, List[Dict]]] = {}
+
+    # -- corpus handling -----------------------------------------------------
+
+    def _cve_description(self, cve_id: str) -> str:
+        """CVE descriptions need tag replacement exactly once
+        (reference: reader_memory.py:96-99)."""
+        rec = self._cve[cve_id]
+        if not rec.get("_normalized"):
+            rec["CVE_Description"] = normalize_text(rec.get("CVE_Description") or "")
+            rec["_normalized"] = True
+        return rec["CVE_Description"]
+
+    def group_by_cwe(self, file_path: str) -> Dict[str, List[Dict]]:
+        """Load a corpus file and bucket samples: negatives under "neg",
+        positives under their CWE category (via the CVE record)."""
+        if file_path in self._grouped_cache:
+            return self._grouped_cache[file_path]
+        samples = json.loads(Path(file_path).read_text())
+        grouped: Dict[str, List[Dict]] = {"neg": []}
+        for s in samples:
+            s["text"] = f"{s.get('Issue_Title') or ''}. {s.get('Issue_Body') or ''}"
+            if str(s.get(self._target)) in ("1", "1.0"):
+                cwe_id = s.get("CWE_ID") or self._cve.get(s.get("CVE_ID"), {}).get("CWE_ID")
+                if cwe_id is None:
+                    continue  # positives lacking a CWE are dirty data
+                s[self._target] = "pos"
+                s["CWE_ID"] = cwe_id
+                grouped.setdefault(cwe_id, []).append(s)
+            else:
+                s[self._target] = "neg"
+                grouped["neg"].append(s)
+        self._grouped_cache[file_path] = grouped
+        return grouped
+
+    # -- instance generation -------------------------------------------------
+
+    def read(self, file_path: str, split: Optional[str] = None) -> Iterator[Dict]:
+        split = split or detect_split(file_path)
+        if split == GOLDEN:
+            yield from self.read_anchors(file_path)
+            return
+        grouped = self.group_by_cwe(file_path)
+        if split in (TEST, VALIDATION, UNLABEL):
+            # reference semantics: test corpora stream as unlabeled scoring
+            # instances, validation as labeled "test" instances
+            # (reference: reader_memory.py:146-162)
+            mode = "test" if split == VALIDATION else UNLABEL
+            count = 0
+            for bucket in grouped.values():
+                for s in bucket:
+                    count += 1
+                    yield self._eval_instance(s, mode)
+            logger.info("%s: %d evaluation instances", file_path, count)
+        else:
+            yield from self._train_pairs(grouped)
+
+    def read_anchors(self, anchor_path: Optional[str] = None) -> Iterator[Dict]:
+        anchors = (
+            json.loads(Path(anchor_path).read_text()) if anchor_path else self._anchors
+        )
+        for category, description in anchors.items():
+            yield {
+                "text1": description,
+                "label": "same",
+                "meta": {"type": GOLDEN, "label": category},
+            }
+
+    def _eval_instance(self, s: Dict, mode: str) -> Dict:
+        positive = s[self._target] == "pos"
+        return {
+            "text1": s["text"],
+            "label": "same" if positive else "diff",
+            "meta": {
+                "type": mode,
+                "label": s.get("CWE_ID") if positive else "neg",
+                "Issue_Url": s.get("Issue_Url"),
+            },
+        }
+
+    def _train_pairs(self, grouped: Dict[str, List[Dict]]) -> Iterator[Dict]:
+        all_data = [s for bucket in grouped.values() for s in bucket]
+        self._rng.shuffle(all_data)
+        anchor_ids = list(self._anchors.keys())
+        same_k, diff_k = self._ratio["same"], self._ratio["diff"]
+        rng = self._rng
+        same_num = diff_num = 0
+
+        for _ in range(self._train_iter):
+            for s in all_data:
+                if s[self._target] == "pos":
+                    yield self._pair_instance(s, s)
+                    partners = grouped[s["CWE_ID"]]
+                    for partner in rng.choices(partners, k=same_k - 1):
+                        yield self._pair_instance(s, partner)
+                    same_num += same_k
+                elif rng.random() < self._sample_neg:
+                    for category in rng.choices(anchor_ids, k=diff_k):
+                        yield self._anchor_pair_instance(s, category)
+                    diff_num += diff_k
+        logger.info("pair counts: same=%d diff=%d", same_num, diff_num)
+
+    def _partner_text(self, s: Dict, partner: Dict) -> str:
+        """Choose the matched pair's second text
+        (reference: reader_memory.py:205-224)."""
+        rng = self._rng
+        if s["Issue_Url"] == partner["Issue_Url"]:
+            return self._cve_description(partner["CVE_ID"])
+        if rng.random() < 0.7:
+            return self._cve_description(partner["CVE_ID"])
+        if rng.random() < 0.5:
+            category = partner.get("CWE_ID")
+            if category is not None and category in self._anchors:
+                return self._anchors[category]
+            return partner["text"]
+        return partner["text"]
+
+    def _pair_instance(self, s: Dict, partner: Dict) -> Dict:
+        return {
+            "text1": s["text"],
+            "text2": self._partner_text(s, partner),
+            "label": "same",
+            "meta": {"type": TRAIN, "label": s["CWE_ID"], "Issue_Url": s["Issue_Url"]},
+        }
+
+    def _anchor_pair_instance(self, s: Dict, category: str) -> Dict:
+        return {
+            "text1": s["text"],
+            "text2": self._anchors[category],
+            "label": "diff",
+            "meta": {"type": TRAIN, "label": "neg", "Issue_Url": s.get("Issue_Url")},
+        }
+
+
+@DatasetReader.register("reader_single")
+class SingleReader(DatasetReader):
+    def __init__(
+        self,
+        sample_neg: Optional[float] = None,
+        target: str = "Security_Issue_Full",
+        seed: Optional[int] = None,
+    ) -> None:
+        self._target = target
+        self._sample_neg = sample_neg
+        self._rng = random.Random(seed)
+
+    def read(self, file_path: str, split: Optional[str] = None) -> Iterator[Dict]:
+        split = split or detect_split(file_path)
+        samples = json.loads(Path(file_path).read_text())
+        for s in samples:
+            positive = str(s.get(self._target)) in ("1", "1.0", "pos")
+            if (
+                split == TRAIN
+                and not positive
+                and self._sample_neg is not None
+                and self._rng.random() >= self._sample_neg
+            ):
+                continue
+            yield {
+                "text1": f"{s.get('Issue_Title') or ''}. {s.get('Issue_Body') or ''}",
+                "label": "pos" if positive else "neg",
+                "meta": {
+                    "type": split,
+                    "label": "pos" if positive else "neg",
+                    "Issue_Url": s.get("Issue_Url"),
+                },
+            }

@@ -1,0 +1,206 @@
+"""Offline WordPiece tokenization producing fixed-shape arrays.
+
+The reference tokenizes through AllenNLP's PretrainedTransformerTokenizer
+(bert-base-uncased wordpieces, reference: MemVul/config_memory.json:16-20).
+This module provides the same wordpiece scheme via the ``tokenizers``
+library, but fully offline: a vocabulary is either loaded from a local
+bert-style ``vocab.txt`` or trained from the corpus itself — there is no
+network dependency.
+
+TPU-first detail: ``encode_batch`` returns *fixed-shape* padded numpy
+arrays (ids / attention mask / type ids), optionally bucketed, so that the
+number of distinct shapes reaching XLA stays small and compile caches hit.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..registry import Registrable
+
+PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+SPECIAL_TOKENS = [PAD, UNK, CLS, SEP, MASK]
+
+# placeholder tags produced by normalize.py — kept as atomic tokens
+_TAG_TOKENS = [
+    "APITAG", "CODETAG", "ERRORTAG", "FILETAG", "URLTAG", "CVETAG",
+    "EMAILTAG", "MENTIONTAG", "PATHTAG", "NUMBERTAG",
+]
+
+
+class TextTokenizer(Registrable):
+    """Base tokenizer interface: text → token ids (no padding)."""
+
+    default_implementation = "wordpiece"
+
+    def encode(self, text: str, max_length: Optional[int] = None) -> List[int]:
+        raise NotImplementedError
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def pad_id(self) -> int:
+        raise NotImplementedError
+
+    def encode_batch(
+        self,
+        texts: Sequence[str],
+        max_length: int,
+        buckets: Optional[Sequence[int]] = None,
+        pad_to: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Encode and pad to a fixed shape.
+
+        ``buckets``: allowed padded lengths (ascending); the smallest bucket
+        covering the longest sequence is chosen (the last bucket caps the
+        length).  ``pad_to`` forces an exact length.  Returns ``input_ids``,
+        ``attention_mask``, ``token_type_ids`` of shape [B, L].
+        """
+        from .batching import _bucket_length, _pad_block
+
+        encoded = [self.encode(t, max_length=max_length) for t in texts]
+        if pad_to is not None:
+            length = pad_to
+        else:
+            length = _bucket_length(encoded, buckets, max_length)
+        block = _pad_block(encoded, len(encoded), self.pad_id, length)
+        block["token_type_ids"] = np.zeros_like(block["input_ids"])
+        return block
+
+
+@TextTokenizer.register("wordpiece")
+class WordPieceTokenizer(TextTokenizer):
+    """BERT-style wordpiece tokenizer backed by the ``tokenizers`` library."""
+
+    def __init__(
+        self,
+        vocab_path: Optional[Union[str, Path]] = None,
+        tokenizer_path: Optional[Union[str, Path]] = None,
+        lowercase: bool = True,
+    ) -> None:
+        from tokenizers import Tokenizer as _FastTokenizer
+
+        if tokenizer_path is not None:
+            self._tok = _FastTokenizer.from_file(str(tokenizer_path))
+        elif vocab_path is not None:
+            self._tok = _bert_tokenizer_from_vocab(str(vocab_path), lowercase)
+        else:
+            raise ValueError("need vocab_path or tokenizer_path")
+        self._cls = self._tok.token_to_id(CLS)
+        self._sep = self._tok.token_to_id(SEP)
+        self._pad = self._tok.token_to_id(PAD)
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def train_from_corpus(
+        cls,
+        texts: Iterable[str],
+        vocab_size: int = 8192,
+        save_path: Optional[Union[str, Path]] = None,
+        lowercase: bool = True,
+    ) -> "WordPieceTokenizer":
+        """Train a wordpiece vocab from raw text — the offline substitute
+        for downloading bert-base-uncased's vocabulary."""
+        from tokenizers import Tokenizer as _FastTokenizer
+        from tokenizers.models import WordPiece as _WordPiece
+        from tokenizers.trainers import WordPieceTrainer
+
+        tok = _FastTokenizer(_WordPiece(unk_token=UNK))
+        _apply_bert_pretokenization(tok, lowercase)
+        trainer = WordPieceTrainer(
+            vocab_size=vocab_size,
+            special_tokens=SPECIAL_TOKENS + _TAG_TOKENS,
+            continuing_subword_prefix="##",
+        )
+        tok.train_from_iterator(texts, trainer)
+        _attach_bert_postprocessor(tok)
+        if save_path is not None:
+            Path(save_path).parent.mkdir(parents=True, exist_ok=True)
+            tok.save(str(save_path))
+        self = cls.__new__(cls)
+        self._tok = tok
+        self._cls = tok.token_to_id(CLS)
+        self._sep = tok.token_to_id(SEP)
+        self._pad = tok.token_to_id(PAD)
+        return self
+
+    # -- interface -----------------------------------------------------------
+
+    def encode(self, text: str, max_length: Optional[int] = None) -> List[int]:
+        ids = self._tok.encode(text).ids
+        if not ids or ids[0] != self._cls:
+            ids = [self._cls] + ids + [self._sep]
+        if max_length is not None and len(ids) > max_length:
+            # keep [CLS] ... [SEP] framing after truncation
+            ids = ids[: max_length - 1] + [self._sep]
+        return ids
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    @property
+    def pad_id(self) -> int:
+        return self._pad
+
+    @property
+    def cls_id(self) -> int:
+        return self._cls
+
+    @property
+    def sep_id(self) -> int:
+        return self._sep
+
+    @property
+    def mask_id(self) -> int:
+        return self._tok.token_to_id(MASK)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tok.token_to_id(token)
+
+    def save(self, path: Union[str, Path]) -> None:
+        self._tok.save(str(path))
+
+
+def _apply_bert_pretokenization(tok, lowercase: bool) -> None:
+    from tokenizers import normalizers, pre_tokenizers
+
+    tok.normalizer = normalizers.BertNormalizer(lowercase=lowercase)
+    tok.pre_tokenizer = pre_tokenizers.BertPreTokenizer()
+
+
+def _attach_bert_postprocessor(tok) -> None:
+    from tokenizers.processors import TemplateProcessing
+
+    tok.post_processor = TemplateProcessing(
+        single=f"{CLS} $A {SEP}",
+        pair=f"{CLS} $A {SEP} $B:1 {SEP}:1",
+        special_tokens=[
+            (CLS, tok.token_to_id(CLS)),
+            (SEP, tok.token_to_id(SEP)),
+        ],
+    )
+
+
+def _bert_tokenizer_from_vocab(vocab_path: str, lowercase: bool):
+    from tokenizers import Tokenizer as _FastTokenizer
+    from tokenizers.models import WordPiece as _WordPiece
+
+    if vocab_path.endswith(".json"):
+        vocab = json.loads(Path(vocab_path).read_text())
+    else:
+        vocab = {
+            line.rstrip("\n"): i
+            for i, line in enumerate(Path(vocab_path).read_text().splitlines())
+        }
+    tok = _FastTokenizer(_WordPiece(vocab, unk_token=UNK))
+    _apply_bert_pretokenization(tok, lowercase)
+    _attach_bert_postprocessor(tok)
+    return tok

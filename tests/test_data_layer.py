@@ -1,0 +1,321 @@
+import json
+
+import numpy as np
+import pytest
+
+from memvul_tpu.data.batching import (
+    LABELS_SIAMESE,
+    CachedEncoder,
+    batches_from_instances,
+    prefetch,
+)
+from memvul_tpu.data.corpus import extract_project, preprocess, split_by_project
+from memvul_tpu.data.cwe import (
+    bfs_subtree,
+    build_anchors,
+    build_cwe_tree,
+    cwe_distribution,
+    describe_cwe,
+)
+from memvul_tpu.data.readers import MemoryReader, SingleReader, detect_split
+from memvul_tpu.data.synthetic import (
+    build_workspace,
+    generate_corpus,
+    research_view_records,
+)
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+
+
+@pytest.fixture(scope="module")
+def workspace(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("ws"), seed=7)
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+
+def test_tokenizer_roundtrip(workspace):
+    tok = workspace["tokenizer"]
+    ids = tok.encode("buffer overflow in the parser")
+    assert ids[0] == tok.cls_id and ids[-1] == tok.sep_id
+    assert len(ids) > 2
+
+
+def test_tokenizer_truncation(workspace):
+    tok = workspace["tokenizer"]
+    ids = tok.encode("word " * 300, max_length=16)
+    assert len(ids) == 16 and ids[-1] == tok.sep_id
+
+
+def test_tokenizer_batch_shapes(workspace):
+    tok = workspace["tokenizer"]
+    batch = tok.encode_batch(["short", "a much longer text " * 5], max_length=64, buckets=[16, 32, 64])
+    assert batch["input_ids"].shape == batch["attention_mask"].shape
+    assert batch["input_ids"].shape[1] in (16, 32, 64)
+
+
+def test_tokenizer_save_load(workspace, tmp_path):
+    tok = workspace["tokenizer"]
+    p = tmp_path / "tok.json"
+    tok.save(p)
+    tok2 = WordPieceTokenizer(tokenizer_path=p)
+    text = "sql injection in the login form"
+    assert tok.encode(text) == tok2.encode(text)
+
+
+def test_tag_tokens_atomic(workspace):
+    tok = workspace["tokenizer"]
+    ids = tok.encode("CVETAG")
+    assert len(ids) == 3  # CLS + tag + SEP
+
+
+# -- corpus pipeline ---------------------------------------------------------
+
+
+def test_extract_project():
+    assert extract_project("https://github.com/foo/bar/issues/12") == "foo/bar"
+    assert extract_project("bogus") == "ERROR"
+
+
+def test_preprocess_temporal_leak_guard():
+    reports, _ = generate_corpus(num_projects=2, seed=1)
+    # forge a CIR created after CVE disclosure
+    leaked = dict(reports[0])
+    leaked["Issue_Created_At"] = "2022-01-01T00:00:00Z"
+    leaked["Issue_Url"] = "https://github.com/org0/repo0/issues/999"
+    clean = preprocess(reports + [leaked])
+    urls = {r["Issue_Url"] for r in clean}
+    assert leaked["Issue_Url"] not in urls
+
+
+def test_preprocess_drops_cirless_projects():
+    reports = [
+        {
+            "Issue_Url": f"https://github.com/solo/neg/issues/{i}",
+            "Issue_Title": "t",
+            "Issue_Body": "b",
+            "Security_Issue_Full": "0",
+        }
+        for i in range(3)
+    ]
+    assert preprocess(reports) == []
+
+
+def test_split_by_project_is_project_level():
+    reports, _ = generate_corpus(num_projects=8, seed=2)
+    train, test = split_by_project(reports, held_out_frac=0.25, seed=3)
+    train_projects = {extract_project(r["Issue_Url"]) for r in train}
+    test_projects = {extract_project(r["Issue_Url"]) for r in test}
+    assert train_projects.isdisjoint(test_projects)
+    assert len(train) + len(test) == len(reports)
+
+
+# -- CWE tree / anchors ------------------------------------------------------
+
+
+def test_cwe_tree_edges():
+    tree = build_cwe_tree(research_view_records())
+    # every non-root is ChildOf the first id in the synthetic table
+    root = research_view_records()[0]["CWE-ID"]
+    assert all(root in tree[k]["father"] for k in tree if k != root)
+    assert len(tree[root]["children"]) == len(tree) - 1
+
+
+def test_bfs_subtree_levels():
+    tree = build_cwe_tree(research_view_records())
+    root = research_view_records()[0]["CWE-ID"]
+    level0 = bfs_subtree(tree, root, level=0)
+    level1 = bfs_subtree(tree, root, level=1)
+    assert level0 == [root]
+    assert set(level1) == set(tree.keys())
+
+
+def test_describe_cwe_contains_fields():
+    tree = build_cwe_tree(research_view_records())
+    text = describe_cwe(tree, "89")
+    assert "SQL Injection" in text
+    assert "Execute Unauthorized Code or Commands" in text
+
+
+def test_build_anchors_deterministic(workspace):
+    reports, cve_dict = generate_corpus(seed=7)
+    positives = [r for r in reports if r["Security_Issue_Full"] == "1"]
+    for r in positives:
+        r["CWE_ID"] = cve_dict[r["CVE_ID"]]["CWE_ID"]
+    dist = cwe_distribution(positives, cve_dict)
+    tree = build_cwe_tree(research_view_records())
+    a1 = build_anchors(dist, tree, cve_dict, seed=5)
+    a2 = build_anchors(dist, tree, cve_dict, seed=5)
+    assert a1 == a2 and len(a1) > 0
+    assert all(k.startswith("CWE-") for k in a1)
+
+
+def test_anchor_for_unknown_cwe_uses_cve_descriptions():
+    cve_dict = {
+        f"CVE-1-{i}": {"CWE_ID": "NVD-CWE-noinfo", "CVE_Description": f"desc {i}"}
+        for i in range(4)
+    }
+    positives = [
+        {"CVE_ID": cve, "CWE_ID": "NVD-CWE-noinfo"} for cve in cve_dict
+    ]
+    dist = cwe_distribution(positives, cve_dict)
+    anchors = build_anchors(dist, {}, cve_dict, seed=0)
+    assert "NVD-CWE-noinfo" in anchors
+    assert "desc" in anchors["NVD-CWE-noinfo"]
+
+
+# -- readers -----------------------------------------------------------------
+
+
+def test_detect_split():
+    assert detect_split("a/train_project.json") == "train"
+    assert detect_split("a/test_project.json") == "test"
+    assert detect_split("a/validation_project.json") == "validation"
+    assert detect_split("a/CWE_anchor_golden_project.json") == "golden"
+
+
+def test_memory_reader_train_pairs(workspace):
+    reader = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+        same_diff_ratio={"same": 4, "diff": 3},
+        sample_neg=1.0,
+        seed=11,
+    )
+    instances = list(reader.read(workspace["paths"]["train"]))
+    assert instances, "no pairs generated"
+    same = [i for i in instances if i["label"] == "same"]
+    diff = [i for i in instances if i["label"] == "diff"]
+    assert same and diff
+    # every diff pair partners a negative report with an anchor description
+    anchor_texts = set(workspace["anchors"].values())
+    assert all(i["text2"] in anchor_texts for i in diff)
+    # matched pairs are generated per positive: 4 each
+    n_pos = len({i["meta"]["Issue_Url"] for i in same})
+    assert len(same) == 4 * n_pos
+
+
+def test_memory_reader_resampling_differs_between_epochs(workspace):
+    reader = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+        same_diff_ratio={"same": 2, "diff": 2},
+        sample_neg=0.5,
+        seed=13,
+    )
+    epoch1 = [(i["text1"], i["text2"]) for i in reader.read(workspace["paths"]["train"])]
+    epoch2 = [(i["text1"], i["text2"]) for i in reader.read(workspace["paths"]["train"])]
+    assert epoch1 != epoch2  # online sampling re-rolls every epoch
+
+
+def test_memory_reader_eval_instances(workspace):
+    reader = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+    )
+    instances = list(reader.read(workspace["paths"]["test"]))
+    raw = json.loads(open(workspace["paths"]["test"]).read())
+    assert len(instances) == len(
+        [r for r in raw if r["Security_Issue_Full"] != "1" or "CVE_ID" in r]
+    )
+    assert all(i["meta"]["type"] == "unlabel" for i in instances)
+    assert all("text2" not in i for i in instances)
+
+
+def test_memory_reader_golden_instances(workspace):
+    reader = MemoryReader(anchor_path=workspace["paths"]["anchors"])
+    golden = list(reader.read(workspace["paths"]["anchors"], split="golden"))
+    assert len(golden) == len(workspace["anchors"])
+    assert all(g["meta"]["type"] == "golden" for g in golden)
+
+
+def test_single_reader_subsamples_negatives(workspace):
+    full = list(SingleReader(seed=3).read(workspace["paths"]["train"], split="validation"))
+    sub = list(SingleReader(sample_neg=0.1, seed=3).read(workspace["paths"]["train"], split="train"))
+    n_neg_full = sum(1 for i in full if i["label"] == "neg")
+    n_neg_sub = sum(1 for i in sub if i["label"] == "neg")
+    assert n_neg_sub < n_neg_full
+    assert sum(1 for i in sub if i["label"] == "pos") == sum(
+        1 for i in full if i["label"] == "pos"
+    )
+
+
+# -- batching ----------------------------------------------------------------
+
+
+def test_batches_fixed_shape_and_weights(workspace):
+    tok = workspace["tokenizer"]
+    enc = CachedEncoder(tok, max_length=32)
+    instances = [
+        {"text1": "a b c", "text2": "d e", "label": "same", "meta": {}}
+        for _ in range(5)
+    ]
+    batches = list(
+        batches_from_instances(instances, enc, batch_size=4, buckets=[16, 32])
+    )
+    assert len(batches) == 2
+    for b in batches:
+        assert b["sample1"]["input_ids"].shape[0] == 4
+        assert b["label"].shape == (4,)
+    assert batches[1]["weight"].tolist() == [1.0, 0.0, 0.0, 0.0]
+    assert batches[0]["sample2"]["input_ids"].shape[0] == 4
+
+
+def test_batches_label_mapping(workspace):
+    enc = CachedEncoder(workspace["tokenizer"], max_length=16)
+    instances = [
+        {"text1": "x", "label": "same", "meta": {}},
+        {"text1": "y", "label": "diff", "meta": {}},
+    ]
+    (batch,) = batches_from_instances(instances, enc, batch_size=2)
+    assert batch["label"].tolist() == [LABELS_SIAMESE["same"], LABELS_SIAMESE["diff"]]
+
+
+def test_prefetch_preserves_order_and_propagates_errors():
+    assert list(prefetch(iter(range(10)), depth=2)) == list(range(10))
+
+    def boom():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        list(prefetch(boom()))
+
+
+def test_cached_encoder_caches(workspace):
+    enc = CachedEncoder(workspace["tokenizer"], max_length=16)
+    a = enc("same text here")
+    b = enc("same text here")
+    assert a is b
+
+
+def test_collate_rejects_mismatched_label_map(workspace):
+    enc = CachedEncoder(workspace["tokenizer"], max_length=16)
+    instances = [{"text1": "x", "label": "pos", "meta": {}}]
+    with pytest.raises(ValueError, match="label 'pos'"):
+        list(batches_from_instances(instances, enc, batch_size=2))
+
+
+def test_prefetch_early_exit_stops_worker():
+    import threading
+
+    before = threading.active_count()
+    for _ in range(5):
+        gen = prefetch(iter(range(1000)), depth=2)
+        next(gen)
+        gen.close()
+    import time
+
+    time.sleep(0.5)
+    assert threading.active_count() <= before + 1
+
+
+def test_explicit_unlabel_split_mode(workspace):
+    reader = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+    )
+    insts = list(reader.read(workspace["paths"]["test"], split="unlabel"))
+    assert all(i["meta"]["type"] == "unlabel" for i in insts)
+    insts_v = list(reader.read(workspace["paths"]["validation"], split="validation"))
+    assert all(i["meta"]["type"] == "test" for i in insts_v)
