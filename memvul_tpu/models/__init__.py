@@ -1,0 +1,8 @@
+from .bert import BertConfig, BertEncoder, BertPooler  # noqa: F401
+from .memory import (  # noqa: F401
+    MemoryModel,
+    anchor_probs,
+    best_anchor_score,
+    pair_loss,
+)
+from .single import SingleModel, classification_loss  # noqa: F401

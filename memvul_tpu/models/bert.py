@@ -1,0 +1,226 @@
+"""A TPU-first BERT encoder in Flax linen.
+
+Replaces the reference's HF/AllenNLP PyTorch BERT stack (reference:
+MemVul/custom_PTM_embedder.py loads ``AutoModel.from_pretrained``).  This
+implementation is built for XLA:
+
+* activations in a configurable ``dtype`` (bf16 on TPU; params stay f32);
+* attention goes through ``memvul_tpu.ops.dot_product_attention`` so the
+  kernel (XLA einsum / Pallas flash / ring) is swappable per config;
+* the layer stack can run under ``nn.scan`` + ``nn.remat`` — one compiled
+  layer body, rematerialized activations — which keeps compile time flat
+  and HBM use low at depth;
+* parameter naming mirrors HF's FlaxBERT layout so torch checkpoints can
+  be converted mechanically (models/convert.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.attention import dot_product_attention, mask_to_bias
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = False
+    scan_layers: bool = False
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 2048, **kw) -> "BertConfig":
+        """2-layer config for tests (the fake-encoder strategy, SURVEY §4)."""
+        defaults = dict(
+            vocab_size=vocab_size,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            intermediate_size=128,
+            max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def base(cls, vocab_size: int = 30522, **kw) -> "BertConfig":
+        """bert-base-uncased geometry (the reference's encoder)."""
+        return cls(vocab_size=vocab_size, **kw)
+
+    def replace(self, **kw) -> "BertConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _dense_init(config: BertConfig):
+    return nn.initializers.normal(stddev=config.initializer_range)
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, deterministic: bool):
+        c = self.config
+        word = nn.Embed(
+            c.vocab_size, c.hidden_size, embedding_init=_dense_init(c),
+            dtype=c.dtype, name="word_embeddings",
+        )(input_ids)
+        position_ids = jnp.arange(input_ids.shape[-1])[None, :]
+        pos = nn.Embed(
+            c.max_position_embeddings, c.hidden_size, embedding_init=_dense_init(c),
+            dtype=c.dtype, name="position_embeddings",
+        )(position_ids)
+        typ = nn.Embed(
+            c.type_vocab_size, c.hidden_size, embedding_init=_dense_init(c),
+            dtype=c.dtype, name="token_type_embeddings",
+        )(token_type_ids)
+        x = word + pos + typ
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype, name="LayerNorm")(x)
+        return nn.Dropout(c.hidden_dropout)(x, deterministic=deterministic)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, bias, deterministic: bool):
+        c = self.config
+        head_dim = c.hidden_size // c.num_heads
+
+        def qkv(name):
+            return nn.DenseGeneral(
+                (c.num_heads, head_dim), kernel_init=_dense_init(c),
+                dtype=c.dtype, name=name,
+            )(hidden)
+
+        query, key, value = qkv("query"), qkv("key"), qkv("value")
+        dropout_rng = None
+        if not deterministic and c.attention_dropout > 0.0:
+            dropout_rng = self.make_rng("dropout")
+        attn = dot_product_attention(
+            query, key, value, bias=bias,
+            dropout_rng=dropout_rng, dropout_rate=c.attention_dropout,
+            deterministic=deterministic, impl=c.attention_impl,
+        )
+        out = nn.DenseGeneral(
+            c.hidden_size, axis=(-2, -1), kernel_init=_dense_init(c),
+            dtype=c.dtype, name="output",
+        )(attn)
+        out = nn.Dropout(c.hidden_dropout)(out, deterministic=deterministic)
+        return nn.LayerNorm(
+            epsilon=c.layer_norm_eps, dtype=c.dtype, name="output_LayerNorm"
+        )(hidden + out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, bias, deterministic: bool):
+        c = self.config
+        hidden = BertSelfAttention(c, name="attention")(hidden, bias, deterministic)
+        inter = nn.Dense(
+            c.intermediate_size, kernel_init=_dense_init(c), dtype=c.dtype,
+            name="intermediate",
+        )(hidden)
+        inter = nn.gelu(inter, approximate=False)
+        out = nn.Dense(
+            c.hidden_size, kernel_init=_dense_init(c), dtype=c.dtype, name="output"
+        )(inter)
+        out = nn.Dropout(c.hidden_dropout)(out, deterministic=deterministic)
+        return nn.LayerNorm(
+            epsilon=c.layer_norm_eps, dtype=c.dtype, name="output_LayerNorm"
+        )(hidden + out)
+
+
+class _ScanBody(nn.Module):
+    """BertLayer adapted to the (carry, y) contract nn.scan expects."""
+
+    config: BertConfig
+    deterministic: bool
+
+    @nn.compact
+    def __call__(self, hidden, bias):
+        out = BertLayer(self.config, name="layer")(hidden, bias, self.deterministic)
+        return out, None
+
+
+class BertEncoderStack(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, bias, deterministic: bool):
+        c = self.config
+        if c.scan_layers:
+            # one compiled layer body scanned over the depth axis: flat
+            # compile time, stacked params [L, ...]
+            body = nn.remat(_ScanBody) if c.remat else _ScanBody
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=c.num_layers,
+                in_axes=(nn.broadcast,),
+            )(c, deterministic, name="layers")
+            hidden, _ = scanned(hidden, bias)
+            return hidden
+        layer_cls = nn.remat(BertLayer, static_argnums=(3,)) if c.remat else BertLayer
+        for i in range(c.num_layers):
+            hidden = layer_cls(c, name=f"layer_{i}")(hidden, bias, deterministic)
+        return hidden
+
+
+class BertEncoder(nn.Module):
+    """input ids → contextual embeddings [B, T, H]."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask,
+        token_type_ids=None,
+        deterministic: bool = True,
+    ):
+        c = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        hidden = BertEmbeddings(c, name="embeddings")(
+            input_ids, token_type_ids, deterministic
+        )
+        bias = mask_to_bias(attention_mask, dtype=c.dtype)
+        return BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)
+
+
+class BertPooler(nn.Module):
+    """tanh(dense(CLS)) — the reference's BertPooler
+    (reference: model_memory.py:64,99)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cls = hidden[:, 0]
+        return nn.tanh(
+            nn.Dense(
+                self.config.hidden_size, kernel_init=_dense_init(self.config),
+                dtype=self.config.dtype, name="dense",
+            )(cls)
+        )

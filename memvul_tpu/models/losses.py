@@ -1,0 +1,17 @@
+"""Shared loss primitives."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_cross_entropy(
+    logits: jax.Array, labels: jax.Array, weights: jax.Array
+) -> jax.Array:
+    """Mean cross-entropy over rows with nonzero weight (padding rows are
+    dead).  Softmax is taken in float32."""
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    total = jnp.maximum(weights.sum(), 1.0)
+    return (nll * weights).sum() / total

@@ -1,0 +1,151 @@
+"""The Siamese memory-network matcher (the flagship model).
+
+Reference semantics (MemVul/model_memory.py):
+
+* encode a text with BERT, take tanh-pooled CLS, optionally pass a
+  ReLU projection header 768→512 (reference: model_memory.py:64-71);
+* training: encode both pair members, classify ``[u, v, |u-v|]`` with a
+  bias-free linear layer into {same, diff}, cross-entropy on
+  ``logits / temperature`` (reference: model_memory.py:150-158);
+* inference: encode the report once and match it against the whole
+  anchor bank.
+
+TPU-first redesign of the inference step: the reference loops/expands
+per anchor (reference: model_memory.py:134-147); here the bias-free
+linear over the concatenation decomposes into three matmuls —
+
+    logits[b,a] = u[b]·W_u + v[a]·W_v + |u[b]-v[a]|·W_d
+
+so the whole bank match is two tiny matmuls plus one batched abs-diff
+contraction, fused by XLA into a single device program against a
+device-resident anchor bank.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .bert import BertConfig, BertEncoder, BertPooler
+from .losses import masked_cross_entropy
+
+
+class ProjectionHeader(nn.Module):
+    """FeedForward(hidden→header_dim, ReLU, dropout) — reference's
+    ``_projector_single`` (model_memory.py:70)."""
+
+    config: BertConfig
+    header_dim: int = 512
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = nn.Dense(self.header_dim, dtype=self.config.dtype, name="dense")(x)
+        x = nn.relu(x)
+        return nn.Dropout(self.config.hidden_dropout)(x, deterministic=deterministic)
+
+
+class MemoryModel(nn.Module):
+    config: BertConfig
+    use_header: bool = True
+    header_dim: int = 512
+    temperature: float = 0.1
+    num_classes: int = 2
+
+    def setup(self):
+        self.encoder = BertEncoder(self.config, name="bert")
+        self.pooler = BertPooler(self.config, name="pooler")
+        if self.use_header:
+            self.header = ProjectionHeader(self.config, self.header_dim, name="header")
+        # bias-free pair classifier over [u, v, |u-v|]
+        # (reference: model_memory.py:73); owned directly so the training
+        # and anchor-match paths share one parameter
+        out_dim = self.header_dim if self.use_header else self.config.hidden_size
+        self.pair_kernel = self.param(
+            "pair_kernel",
+            nn.initializers.normal(stddev=self.config.initializer_range),
+            (3 * out_dim, self.num_classes),
+        )
+
+    def encode(self, sample, deterministic: bool = True) -> jax.Array:
+        """Token batch {input_ids, attention_mask[, token_type_ids]} → [B, D]."""
+        hidden = self.encoder(
+            sample["input_ids"],
+            sample["attention_mask"],
+            sample.get("token_type_ids"),
+            deterministic=deterministic,
+        )
+        pooled = self.pooler(hidden)
+        if self.use_header:
+            pooled = self.header(pooled, deterministic=deterministic)
+        return pooled
+
+    def pair_logits(self, u: jax.Array, v: jax.Array) -> jax.Array:
+        """[B, D] × [B, D] → [B, 2] (training path)."""
+        features = jnp.concatenate([u, v, jnp.abs(u - v)], axis=-1)
+        return features @ self.pair_kernel.astype(features.dtype)
+
+    def match_anchors(self, u: jax.Array, anchors: jax.Array) -> jax.Array:
+        """[B, D] × [A, D] → logits [B, A, 2] against the full bank.
+
+        Decomposes the concat-linear so no [B, A, 3D] tensor is built:
+        only the |u-v| term needs a [B, A, D] intermediate.
+        """
+        d = u.shape[-1]
+        kernel = self.pair_kernel.astype(u.dtype)
+        w_u, w_v, w_d = kernel[:d], kernel[d : 2 * d], kernel[2 * d :]
+        term_u = u @ w_u  # [B, 2]
+        term_v = anchors @ w_v  # [A, 2]
+        diff = jnp.abs(u[:, None, :] - anchors[None, :, :])  # [B, A, D]
+        term_d = jnp.einsum("bad,dc->bac", diff, w_d)
+        return term_u[:, None, :] + term_v[None, :, :] + term_d
+
+    def __call__(
+        self,
+        sample1,
+        sample2=None,
+        anchors: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        """Training: (sample1, sample2) → pair logits [B, 2].
+        Inference: (sample1, anchors=[A, D]) → anchor logits [B, A, 2]."""
+        u = self.encode(sample1, deterministic=deterministic)
+        if anchors is not None:
+            return self.match_anchors(u, anchors)
+        if sample2 is None:
+            return u
+        v = self.encode(sample2, deterministic=deterministic)
+        return self.pair_logits(u, v)
+
+    def loss(self, logits, labels, weights) -> jax.Array:
+        """Pair loss at this model's configured temperature."""
+        return pair_loss(logits, labels, weights, self.temperature)
+
+
+def pair_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    weights: jax.Array,
+    temperature: float,
+) -> jax.Array:
+    """Mean CE over real rows of ``logits/temperature``
+    (reference: model_memory.py:158)."""
+    return masked_cross_entropy(
+        logits.astype(jnp.float32) / temperature, labels, weights
+    )
+
+
+def anchor_probs(anchor_logits: jax.Array, same_index: int = 0) -> jax.Array:
+    """[B, A, 2] logits → per-anchor P(same) [B, A]."""
+    probs = jax.nn.softmax(anchor_logits.astype(jnp.float32), axis=-1)
+    return probs[..., same_index]
+
+
+def best_anchor_score(anchor_logits: jax.Array, same_index: int = 0):
+    """Reference decision rule (model_memory.py:144-147, predict_memory.py
+    :168-177): the report's positive-class probability is its *best* anchor
+    match.  Returns (max P(same) [B], argmax anchor index [B])."""
+    p_same = anchor_probs(anchor_logits, same_index)
+    return p_same.max(axis=-1), p_same.argmax(axis=-1)

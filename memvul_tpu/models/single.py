@@ -1,0 +1,49 @@
+"""The single (no-memory) classifier — "MemVul-m".
+
+Plain BERT sequence classification: tanh-pooled CLS → FeedForward
+(hidden→512, ReLU, dropout) → bias-free Linear(512→2)
+(reference: MemVul/model_single.py:56-65,84-94).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .bert import BertConfig, BertEncoder, BertPooler
+from .losses import masked_cross_entropy
+from .memory import ProjectionHeader
+
+
+class SingleModel(nn.Module):
+    config: BertConfig
+    header_dim: int = 512
+    num_classes: int = 2
+
+    def setup(self):
+        self.encoder = BertEncoder(self.config, name="bert")
+        self.pooler = BertPooler(self.config, name="pooler")
+        self.header = ProjectionHeader(self.config, self.header_dim, name="header")
+        self.classifier = nn.Dense(
+            self.num_classes, use_bias=False, dtype=self.config.dtype,
+            name="classifier",
+        )
+
+    def __call__(self, sample1, deterministic: bool = True) -> jax.Array:
+        hidden = self.encoder(
+            sample1["input_ids"],
+            sample1["attention_mask"],
+            sample1.get("token_type_ids"),
+            deterministic=deterministic,
+        )
+        pooled = self.pooler(hidden)
+        pooled = self.header(pooled, deterministic=deterministic)
+        return self.classifier(pooled)
+
+
+def classification_loss(
+    logits: jax.Array, labels: jax.Array, weights: jax.Array
+) -> jax.Array:
+    """Mean CE over real rows (reference: model_single.py:95-97)."""
+    return masked_cross_entropy(logits, labels, weights)

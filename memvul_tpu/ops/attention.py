@@ -1,0 +1,83 @@
+"""Attention kernels with a swappable implementation.
+
+The encoder calls one function — :func:`dot_product_attention` — and the
+``impl`` knob selects the backend:
+
+* ``"xla"``     einsum formulation; XLA fuses softmax+matmul well on the
+                MXU and this is the right default at seq-len ≤ 512.
+* ``"flash"``   Pallas blockwise (flash) attention for long sequences;
+                falls back to ``"xla"`` on non-TPU backends.
+* ``"ring"``    sequence-parallel ring attention (memvul_tpu.parallel.ring)
+                used under shard_map when the sequence axis is sharded.
+
+Shapes follow the JAX convention [batch, seq, heads, head_dim].
+Softmax is computed in float32 regardless of the activation dtype — on
+TPU the matmuls run in bf16 on the MXU while the reduction stays
+numerically safe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    bias: Optional[jax.Array] = None,
+    dropout_rng: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    impl: str = "xla",
+) -> jax.Array:
+    """Scaled dot-product attention.
+
+    query/key/value: [B, T, H, Dh]; bias broadcastable to [B, H, Tq, Tk].
+    Returns [B, Tq, H, Dh] in the dtype of ``query``.
+    """
+    if impl == "flash":
+        if deterministic or dropout_rate == 0.0:
+            from .pallas.flash_attention import flash_attention_or_fallback
+
+            return flash_attention_or_fallback(query, key, value, bias)
+        # the flash kernel has no dropout support — training steps with
+        # attention dropout route through the XLA formulation instead of
+        # silently dropping the dropout
+    elif impl == "ring":
+        raise ValueError(
+            "impl='ring' is sequence-parallel attention: it runs via "
+            "memvul_tpu.parallel.ring under shard_map with the sequence "
+            "axis sharded, not through dot_product_attention"
+        )
+    elif impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return _xla_attention(
+        query, key, value, bias, dropout_rng, dropout_rate, deterministic
+    )
+
+
+def _xla_attention(
+    query, key, value, bias, dropout_rng, dropout_rate, deterministic
+) -> jax.Array:
+    depth = query.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", query, key) / jnp.sqrt(
+        jnp.asarray(depth, dtype=query.dtype)
+    )
+    if bias is not None:
+        scores = scores + bias
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(query.dtype)
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        weights = weights * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, value)
+
+
+def mask_to_bias(attention_mask: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[B, T] {0,1} mask → additive bias [B, 1, 1, T] with -inf-ish fill."""
+    neg = jnp.finfo(dtype).min
+    bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+    return bias.astype(dtype)

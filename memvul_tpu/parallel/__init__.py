@@ -1,0 +1,1 @@
+from .mesh import create_mesh, shard_batch, replicate  # noqa: F401

@@ -6,12 +6,19 @@ avoids importing jax at module scope until the env vars are in place.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU for tests even if the ambient env targets the TPU
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# a sitecustomize hook may have pinned the platform (e.g. the axon TPU
+# plugin) before this file runs — override through jax.config too
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

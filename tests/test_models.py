@@ -1,0 +1,238 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from memvul_tpu.models import (
+    BertConfig,
+    BertEncoder,
+    MemoryModel,
+    SingleModel,
+    anchor_probs,
+    best_anchor_score,
+    classification_loss,
+    pair_loss,
+)
+from memvul_tpu.parallel import create_mesh, replicate, shard_batch
+
+B, T, A = 4, 16, 6
+CFG = BertConfig.tiny(vocab_size=512)
+
+
+def token_batch(rng, batch=B, seq=T):
+    ids = rng.integers(4, 500, size=(batch, seq)).astype(np.int32)
+    mask = np.ones((batch, seq), dtype=np.int32)
+    mask[:, seq - 3 :] = 0
+    return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(scope="module")
+def pair_setup(rng):
+    model = MemoryModel(CFG)
+    s1, s2 = token_batch(rng), token_batch(rng)
+    params = model.init(jax.random.PRNGKey(0), s1, s2)
+    return model, params, s1, s2
+
+
+def test_encoder_output_shape(rng):
+    enc = BertEncoder(CFG)
+    batch = token_batch(rng)
+    params = enc.init(jax.random.PRNGKey(0), batch["input_ids"], batch["attention_mask"])
+    out = enc.apply(params, batch["input_ids"], batch["attention_mask"])
+    assert out.shape == (B, T, CFG.hidden_size)
+    assert jnp.isfinite(out).all()
+
+
+def test_mask_actually_masks(rng):
+    enc = BertEncoder(CFG)
+    batch = token_batch(rng)
+    params = enc.init(jax.random.PRNGKey(0), batch["input_ids"], batch["attention_mask"])
+    out1 = enc.apply(params, batch["input_ids"], batch["attention_mask"])
+    # perturb tokens under the mask: visible positions must not change
+    ids2 = batch["input_ids"].at[:, T - 1].set(7)
+    out2 = enc.apply(params, ids2, batch["attention_mask"])
+    np.testing.assert_allclose(
+        out1[:, : T - 3], out2[:, : T - 3], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_memory_model_pair_path(pair_setup):
+    model, params, s1, s2 = pair_setup
+    logits = model.apply(params, s1, s2)
+    assert logits.shape == (B, 2)
+
+
+def test_memory_model_encode_path(pair_setup):
+    model, params, s1, _ = pair_setup
+    u = model.apply(params, s1)
+    assert u.shape == (B, 512)  # header output
+
+
+def test_anchor_match_equals_concat_formulation(pair_setup):
+    model, params, s1, _ = pair_setup
+    u = model.apply(params, s1)
+    anchors = jax.random.normal(jax.random.PRNGKey(1), (A, u.shape[-1]))
+    logits = model.apply(params, s1, anchors=anchors)
+    assert logits.shape == (B, A, 2)
+    # explicit concat formulation, one anchor at a time
+    kernel = params["params"]["pair_kernel"]
+    for a in range(A):
+        feats = jnp.concatenate(
+            [u, jnp.broadcast_to(anchors[a], u.shape), jnp.abs(u - anchors[a])],
+            axis=-1,
+        )
+        np.testing.assert_allclose(
+            np.asarray(feats @ kernel), np.asarray(logits[:, a]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_best_anchor_score_picks_max():
+    logits = jnp.asarray(
+        [[[5.0, 0.0], [1.0, 0.0]], [[0.0, 5.0], [3.0, 0.0]]]
+    )  # [2, 2 anchors, 2]
+    p = anchor_probs(logits)
+    score, idx = best_anchor_score(logits)
+    assert idx.tolist() == [0, 1]
+    np.testing.assert_allclose(score, p.max(axis=-1))
+
+
+def test_pair_loss_ignores_padding_rows():
+    logits = jnp.asarray([[2.0, 0.0], [0.0, 2.0], [9.0, -9.0]])
+    labels = jnp.asarray([0, 1, 1])  # last row is padding and totally wrong
+    full = pair_loss(logits, labels, jnp.asarray([1.0, 1.0, 1.0]), 1.0)
+    masked = pair_loss(logits, labels, jnp.asarray([1.0, 1.0, 0.0]), 1.0)
+    assert masked < full
+
+
+def test_temperature_scales_loss():
+    logits = jnp.asarray([[1.0, 0.0]])
+    labels = jnp.asarray([0])
+    w = jnp.asarray([1.0])
+    sharp = pair_loss(logits, labels, w, 0.1)
+    soft = pair_loss(logits, labels, w, 1.0)
+    assert sharp < soft  # temperature sharpens correct predictions
+
+
+def test_single_model(rng):
+    model = SingleModel(CFG)
+    batch = token_batch(rng)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    logits = model.apply(params, batch)
+    assert logits.shape == (B, 2)
+    loss = classification_loss(logits, jnp.zeros(B, dtype=jnp.int32), jnp.ones(B))
+    assert jnp.isfinite(loss)
+
+
+def test_dropout_rng_changes_training_output(pair_setup):
+    model, params, s1, s2 = pair_setup
+    out1 = model.apply(
+        params, s1, s2, deterministic=False, rngs={"dropout": jax.random.PRNGKey(1)}
+    )
+    out2 = model.apply(
+        params, s1, s2, deterministic=False, rngs={"dropout": jax.random.PRNGKey(2)}
+    )
+    assert not np.allclose(out1, out2)
+
+
+def test_jit_compiles_and_matches_eager(pair_setup):
+    model, params, s1, s2 = pair_setup
+    eager = model.apply(params, s1, s2)
+    jitted = jax.jit(lambda p, a, b: model.apply(p, a, b))(params, s1, s2)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), rtol=1e-5, atol=1e-5)
+
+
+def test_scan_and_remat_variants_run(rng):
+    batch = token_batch(rng)
+    for cfg in [CFG.replace(scan_layers=True), CFG.replace(remat=True),
+                CFG.replace(scan_layers=True, remat=True)]:
+        enc = BertEncoder(cfg)
+        params = enc.init(
+            jax.random.PRNGKey(0), batch["input_ids"], batch["attention_mask"]
+        )
+        out = enc.apply(params, batch["input_ids"], batch["attention_mask"])
+        assert out.shape == (B, T, cfg.hidden_size)
+    # scan stacks layer params: [L, ...]
+    scanned = BertEncoder(CFG.replace(scan_layers=True)).init(
+        jax.random.PRNGKey(0), batch["input_ids"], batch["attention_mask"]
+    )
+    stack = scanned["params"]["encoder"]["layers"]["layer"]
+    leaf = jax.tree_util.tree_leaves(stack)[0]
+    assert leaf.shape[0] == CFG.num_layers
+
+
+def test_bf16_forward_finite(rng):
+    cfg = CFG.replace(dtype=jnp.bfloat16)
+    model = MemoryModel(cfg)
+    s1, s2 = token_batch(rng), token_batch(rng)
+    params = model.init(jax.random.PRNGKey(0), s1, s2)
+    logits = model.apply(params, s1, s2)
+    assert logits.dtype == jnp.bfloat16
+    assert jnp.isfinite(logits.astype(jnp.float32)).all()
+
+
+# -- sharded execution over the virtual 8-device mesh ------------------------
+
+
+def test_sharded_anchor_scoring(pair_setup):
+    model, params, _, _ = pair_setup
+    mesh = create_mesh()
+    assert mesh.devices.size == 8
+    rng = np.random.default_rng(3)
+    batch = token_batch(rng, batch=16)
+    batch = shard_batch(batch, mesh)
+    params_r = replicate(params, mesh)
+    anchors = replicate(
+        jnp.asarray(np.random.default_rng(4).normal(size=(A, 512)), dtype=jnp.float32),
+        mesh,
+    )
+
+    @jax.jit
+    def score(p, b, anc):
+        logits = model.apply(p, b, anchors=anc)
+        return best_anchor_score(logits)[0]
+
+    scores = score(params_r, batch, anchors)
+    assert scores.shape == (16,)
+    # compare against unsharded run
+    ref = score(params, jax.device_get(batch), jax.device_get(anchors))
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_model_loss_method_uses_temperature(pair_setup):
+    model, params, s1, s2 = pair_setup
+    logits = model.apply(params, s1, s2)
+    labels = jnp.zeros(B, dtype=jnp.int32)
+    w = jnp.ones(B)
+    via_model = model.apply(params, logits, labels, w, method=model.loss)
+    direct = pair_loss(logits, labels, w, model.temperature)
+    np.testing.assert_allclose(np.asarray(via_model), np.asarray(direct))
+
+
+def test_shard_batch_handles_modelonly_mesh_and_scalars(pair_setup):
+    mesh = create_mesh({"model": 8})
+    out = shard_batch({"x": np.ones((16, 4)), "s": np.float32(3.0), "meta": ["a"]}, mesh)
+    assert out["x"].shape == (16, 4)
+    assert out["meta"] == ["a"]
+
+
+def test_flash_impl_falls_back_on_cpu(rng):
+    from memvul_tpu.ops import dot_product_attention
+
+    q = jnp.asarray(rng.normal(size=(2, 8, 4, 16)), jnp.float32)
+    ref = dot_product_attention(q, q, q, impl="xla")
+    out = dot_product_attention(q, q, q, impl="flash")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
+
+
+def test_ring_impl_raises_with_guidance():
+    from memvul_tpu.ops import dot_product_attention
+
+    q = jnp.zeros((1, 4, 2, 8))
+    with pytest.raises(ValueError, match="shard_map"):
+        dot_product_attention(q, q, q, impl="ring")
