@@ -1,0 +1,3 @@
+from .measure import cal_metrics  # noqa: F401
+from .predict_memory import SiamesePredictor, test_siamese  # noqa: F401
+from .predict_single import SinglePredictor, test_single  # noqa: F401

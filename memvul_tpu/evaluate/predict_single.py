@@ -1,0 +1,134 @@
+"""Single-model (MemVul-m / TextCNN) inference.
+
+Reference flow (predict_single.py:46-121): stream the test set, record
+``{"Issue_Url", "label", "predict", "prob"}`` per report — ``predict`` is
+the argmax label, ``prob`` the positive-class probability — then compute
+the standard measure without a threshold sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from ..data.batching import (
+    LABELS_BINARY,
+    CachedEncoder,
+    batches_from_instances,
+    prefetch,
+)
+from ..data.readers import DatasetReader, SingleReader
+from ..parallel.mesh import create_mesh, replicate, shard_batch
+from ..training.metrics import model_measure
+
+logger = logging.getLogger(__name__)
+
+POS_INDEX = LABELS_BINARY["pos"]
+
+
+class SinglePredictor:
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer,
+        mesh=None,
+        batch_size: int = 512,
+        max_length: int = 512,
+        buckets: Optional[Sequence[int]] = None,
+    ) -> None:
+        self.model = model
+        self.mesh = mesh
+        self.batch_size = batch_size
+        self.encoder = CachedEncoder(tokenizer, max_length=max_length)
+        self.buckets = tuple(buckets) if buckets else None
+        self.params = replicate(params, mesh) if mesh is not None else params
+        self._probs_fn = jax.jit(
+            lambda p, b: jax.nn.softmax(
+                self.model.apply(p, b, deterministic=True).astype(np.float32), axis=-1
+            )
+        )
+
+    def predict_file(
+        self,
+        reader: DatasetReader,
+        test_path: Union[str, Path],
+        out_path: Union[str, Path],
+        split: Optional[str] = None,
+    ) -> Dict[str, float]:
+        batches = batches_from_instances(
+            reader.read(str(test_path), split=split),
+            self.encoder,
+            batch_size=self.batch_size,
+            label_map=LABELS_BINARY,
+            buckets=self.buckets,
+            pad_to_max=self.buckets is None,
+        )
+        labels: List[int] = []
+        preds: List[int] = []
+        scores: List[float] = []
+        n = 0
+        start = time.perf_counter()
+        with open(out_path, "w") as f:
+            for batch in prefetch(batches):
+                sample = batch["sample1"]
+                if self.mesh is not None:
+                    sample = shard_batch(sample, self.mesh)
+                probs = np.asarray(self._probs_fn(self.params, sample))
+                real = len(batch["meta"])
+                records = []
+                for row, meta in zip(probs[:real], batch["meta"]):
+                    p_pos = float(row[POS_INDEX])
+                    predicted = int(np.argmax(row))
+                    records.append(
+                        {
+                            "Issue_Url": meta.get("Issue_Url"),
+                            "label": meta.get("label"),
+                            "predict": "pos" if predicted == POS_INDEX else "neg",
+                            "prob": p_pos,
+                        }
+                    )
+                    labels.append(0 if meta.get("label") == "neg" else 1)
+                    preds.append(1 if predicted == POS_INDEX else 0)
+                    scores.append(p_pos)
+                n += real
+                f.write(json.dumps(records) + "\n")
+        elapsed = time.perf_counter() - start
+        logger.info(
+            "scored %d reports in %.1fs (%.0f reports/s)", n, elapsed, n / max(elapsed, 1e-9)
+        )
+        measured = model_measure(labels, preds, scores)
+        measured["num_samples"] = n
+        measured["elapsed_s"] = elapsed
+        return measured
+
+
+def test_single(
+    model,
+    params,
+    tokenizer,
+    test_file: Union[str, Path],
+    out_results: Union[str, Path],
+    out_metrics: Optional[Union[str, Path]] = None,
+    reader: Optional[DatasetReader] = None,
+    mesh=None,
+    use_mesh: bool = True,
+    batch_size: int = 512,
+    max_length: int = 512,
+) -> Dict[str, float]:
+    reader = reader or SingleReader()
+    if mesh is None and use_mesh and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    predictor = SinglePredictor(
+        model, params, tokenizer, mesh=mesh, batch_size=batch_size, max_length=max_length
+    )
+    measured = predictor.predict_file(reader, test_file, out_results)
+    if out_metrics is not None:
+        Path(out_metrics).write_text(json.dumps(measured, indent=4))
+    return measured

@@ -200,6 +200,12 @@ class BertEncoder(nn.Module):
         deterministic: bool = True,
     ):
         c = self.config
+        if input_ids.shape[-1] > c.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {input_ids.shape[-1]} exceeds "
+                f"max_position_embeddings={c.max_position_embeddings}; "
+                "fold or truncate long inputs before encoding"
+            )
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         hidden = BertEmbeddings(c, name="embeddings")(
@@ -210,17 +216,20 @@ class BertEncoder(nn.Module):
 
 
 class BertPooler(nn.Module):
-    """tanh(dense(CLS)) — the reference's BertPooler
-    (reference: model_memory.py:64,99)."""
+    """dropout(tanh(dense(CLS))) — the reference's BertPooler including its
+    post-pool dropout (reference: model_memory.py:64,99)."""
 
     config: BertConfig
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, deterministic: bool = True):
         cls = hidden[:, 0]
-        return nn.tanh(
+        pooled = nn.tanh(
             nn.Dense(
                 self.config.hidden_size, kernel_init=_dense_init(self.config),
                 dtype=self.config.dtype, name="dense",
             )(cls)
+        )
+        return nn.Dropout(self.config.hidden_dropout)(
+            pooled, deterministic=deterministic
         )

@@ -77,7 +77,7 @@ class MemoryModel(nn.Module):
             sample.get("token_type_ids"),
             deterministic=deterministic,
         )
-        pooled = self.pooler(hidden)
+        pooled = self.pooler(hidden, deterministic=deterministic)
         if self.use_header:
             pooled = self.header(pooled, deterministic=deterministic)
         return pooled

@@ -37,7 +37,7 @@ class SingleModel(nn.Module):
             sample1.get("token_type_ids"),
             deterministic=deterministic,
         )
-        pooled = self.pooler(hidden)
+        pooled = self.pooler(hidden, deterministic=deterministic)
         pooled = self.header(pooled, deterministic=deterministic)
         return self.classifier(pooled)
 

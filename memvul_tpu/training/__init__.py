@@ -1,0 +1,6 @@
+from .metrics import (  # noqa: F401
+    SiameseMeasure,
+    binary_confusion,
+    find_best_threshold,
+    model_measure,
+)

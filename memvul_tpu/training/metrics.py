@@ -1,0 +1,185 @@
+"""Evaluation metrics matching the reference's arithmetic.
+
+* :func:`binary_confusion` / :func:`model_measure` — TP/FN/TN/FP with
+  recall/precision/F1 plus ROC-AUC and average precision
+  (reference: predict_memory.py:117-156, custom_metric.py:9-32).
+* :func:`find_best_threshold` — decision-threshold sweep 0.50→0.90 step
+  0.01 keeping the best F1 (ties go to the *higher* threshold, matching
+  the reference's ``>=`` update — custom_metric.py:35-52).
+* :class:`SiameseMeasure` — accumulates per-report (label, best-anchor
+  probability) during evaluation and computes the swept F1 only when the
+  full pass is done (reference: custom_metric.py:56-98); drives model
+  selection via ``+s_f1-score``.
+* :class:`RunningClassification` — streaming accuracy + per-class and
+  weighted P/R/F1 (the reference's CategoricalAccuracy/FBetaMeasure trio,
+  model_memory.py:80-84) from a confusion matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+from sklearn import metrics as _skm
+
+
+def binary_confusion(
+    labels: Sequence[int], preds: Sequence[int]
+) -> Tuple[int, int, int, int]:
+    labels = np.asarray(labels)
+    preds = np.asarray(preds)
+    tp = int(((preds == 1) & (labels == 1)).sum())
+    fn = int(((preds == 0) & (labels == 1)).sum())
+    tn = int(((preds == 0) & (labels == 0)).sum())
+    fp = int(((preds == 1) & (labels == 0)).sum())
+    return tp, fn, tn, fp
+
+
+def _prf(tp: int, fn: int, fp: int) -> Tuple[float, float, float]:
+    recall = tp / (tp + fn) if tp + fn else 0.0
+    precision = tp / (tp + fp) if tp + fp else 0.0
+    f1 = (
+        2 * recall * precision / (recall + precision) if recall + precision else 0.0
+    )
+    return precision, recall, f1
+
+
+def model_measure(
+    labels: Sequence[int], preds: Sequence[int], scores: Sequence[float]
+) -> Dict[str, float]:
+    """The reference's headline metric dict
+    (reference: predict_memory.py:154)."""
+    tp, fn, tn, fp = binary_confusion(labels, preds)
+    precision, recall, f1 = _prf(tp, fn, fp)
+    fpr, tpr, _ = _skm.roc_curve(labels, scores, pos_label=1)
+    auc = _skm.auc(fpr, tpr)
+    ap = _skm.average_precision_score(labels, scores, pos_label=1)
+    return {
+        "TP": tp, "FN": fn, "TN": tn, "FP": fp,
+        "pd&recall": recall, "prec": precision, "f1": f1,
+        "ap": float(ap), "auc": float(auc),
+    }
+
+
+def find_best_threshold(
+    labels: Sequence[int],
+    scores: Sequence[float],
+    interval: Tuple[float, float] = (0.5, 0.9),
+    step: float = 0.01,
+) -> Dict[str, float]:
+    labels = np.asarray(labels)
+    scores = np.asarray(scores)
+    best: Optional[Dict[str, float]] = None
+    best_f1 = 0.0
+    for thres in np.arange(interval[0], interval[1], step):
+        preds = (scores >= thres).astype(int)
+        tp, fn, tn, fp = binary_confusion(labels, preds)
+        precision, recall, f1 = _prf(tp, fn, fp)
+        if f1 >= best_f1:
+            best_f1 = f1
+            best = {
+                "TP": tp, "FN": fn, "TN": tn, "FP": fp,
+                "precision": precision, "recall": recall, "f1": f1,
+                "thres": float(thres),
+            }
+    return best or {
+        "TP": 0, "FN": 0, "TN": 0, "FP": 0,
+        "precision": 0.0, "recall": 0.0, "f1": 0.0, "thres": interval[0],
+    }
+
+
+class SiameseMeasure:
+    """Streaming (label, best-anchor-probability) accumulator."""
+
+    def __init__(self) -> None:
+        self._labels: List[int] = []
+        self._scores: List[float] = []
+
+    def update(self, scores: Iterable[float], metas: Iterable[Dict]) -> None:
+        """``scores``: per-report P(same) already reduced over anchors;
+        ``metas``: instance metadata with ``label`` ("neg" or a CWE id)."""
+        for score, meta in zip(scores, metas):
+            self._labels.append(0 if meta.get("label") == "neg" else 1)
+            self._scores.append(float(score))
+
+    def __len__(self) -> int:
+        return len(self._labels)
+
+    def compute(self, reset: bool = True) -> Dict[str, float]:
+        empty = {
+            "precision": 0.0, "recall": 0.0, "f1": 0.0, "thres": 0.0,
+            "auc": 0.0, "ave_precision_score": 0.0,
+        }
+        if not self._scores:
+            return empty
+        best = find_best_threshold(self._labels, self._scores)
+        fpr, tpr, _ = _skm.roc_curve(self._labels, self._scores, pos_label=1)
+        out = {
+            "precision": best["precision"],
+            "recall": best["recall"],
+            "f1": best["f1"],
+            "thres": best["thres"],
+            "auc": float(_skm.auc(fpr, tpr)),
+            "ave_precision_score": float(
+                _skm.average_precision_score(self._labels, self._scores, pos_label=1)
+            ),
+        }
+        if reset:
+            self.reset()
+        return out
+
+    def reset(self) -> None:
+        self._labels.clear()
+        self._scores.clear()
+
+
+class RunningClassification:
+    """Streaming accuracy + per-class / weighted P/R/F1 from a confusion
+    matrix (replaces the reference's AllenNLP metric objects)."""
+
+    def __init__(self, num_classes: int, class_names: Sequence[str]) -> None:
+        self.num_classes = num_classes
+        self.class_names = list(class_names)
+        self._cm = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def update(
+        self,
+        preds: Sequence[int],
+        labels: Sequence[int],
+        weights: Optional[Sequence[float]] = None,
+    ) -> None:
+        preds = np.asarray(preds)
+        labels = np.asarray(labels)
+        keep = (
+            np.asarray(weights) > 0 if weights is not None else np.ones_like(preds, bool)
+        )
+        for p, l in zip(preds[keep], labels[keep]):
+            self._cm[l, p] += 1
+
+    def compute(self, reset: bool = False) -> Dict[str, float]:
+        cm = self._cm
+        support = cm.sum(axis=1)
+        total = cm.sum()
+        out: Dict[str, float] = {
+            "accuracy": float(np.trace(cm) / total) if total else 0.0
+        }
+        per_class = []
+        for i, name in enumerate(self.class_names):
+            tp = cm[i, i]
+            fp = cm[:, i].sum() - tp
+            fn = support[i] - tp
+            precision, recall, f1 = _prf(int(tp), int(fn), int(fp))
+            per_class.append((precision, recall, f1))
+            out[f"{name}_precision"] = precision
+            out[f"{name}_recall"] = recall
+            out[f"{name}_f1-score"] = f1
+        if total:
+            w = support / total
+            out["precision"] = float(sum(w[i] * per_class[i][0] for i in range(self.num_classes)))
+            out["recall"] = float(sum(w[i] * per_class[i][1] for i in range(self.num_classes)))
+            out["f1-score"] = float(sum(w[i] * per_class[i][2] for i in range(self.num_classes)))
+        else:
+            out["precision"] = out["recall"] = out["f1-score"] = 0.0
+        if reset:
+            self._cm[:] = 0
+        return out

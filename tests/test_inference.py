@@ -1,0 +1,142 @@
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu.data.readers import MemoryReader, SingleReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.evaluate import cal_metrics
+from memvul_tpu.evaluate import test_siamese as run_siamese_eval
+from memvul_tpu.evaluate import test_single as run_single_eval
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.models import BertConfig, MemoryModel, SingleModel
+from memvul_tpu.parallel import create_mesh
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("infer"), seed=3)
+
+
+@pytest.fixture(scope="module")
+def memory_setup(ws):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    return model, params, reader
+
+
+def test_full_siamese_eval_pipeline(ws, memory_setup, tmp_path):
+    model, params, reader = memory_setup
+    out_results = tmp_path / "memvul_result.json"
+    out_metrics = tmp_path / "memvul_metric_all.json"
+    metrics = run_siamese_eval(
+        model, params, ws["tokenizer"],
+        test_file=ws["paths"]["test"],
+        golden_file=ws["paths"]["anchors"],
+        out_results=out_results,
+        out_metrics=out_metrics,
+        reader=reader,
+        batch_size=16,
+        max_length=64,
+    )
+    # result file: reference format — JSON lines of record lists
+    lines = [json.loads(l) for l in out_results.read_text().splitlines()]
+    records = [r for line in lines for r in line]
+    test_corpus = json.loads(open(ws["paths"]["test"]).read())
+    assert len(records) == len(test_corpus)
+    first = records[0]
+    assert set(first) == {"Issue_Url", "label", "predict"}
+    assert set(first["predict"]) == set(ws["anchors"])  # one score per anchor
+    assert all(0.0 <= p <= 1.0 for p in first["predict"].values())
+    # metric file exists and has the reference keys
+    saved = json.loads(out_metrics.read_text())
+    for key in ["TP", "FN", "TN", "FP", "pd&recall", "prec", "f1", "ap", "auc", "thres"]:
+        assert key in saved
+    assert saved["TP"] + saved["FN"] + saved["TN"] + saved["FP"] == len(records)
+    assert metrics["f1"] == saved["f1"]
+
+
+def test_sharded_matches_unsharded(ws, memory_setup, tmp_path):
+    model, params, reader = memory_setup
+    mesh = create_mesh()
+    r1 = tmp_path / "sharded_result.json"
+    r2 = tmp_path / "unsharded_result.json"
+    pred_mesh = SiamesePredictor(
+        model, params, ws["tokenizer"], mesh=mesh, batch_size=16, max_length=64
+    )
+    pred_plain = SiamesePredictor(
+        model, params, ws["tokenizer"], mesh=None, batch_size=16, max_length=64
+    )
+    for pred, path in [(pred_mesh, r1), (pred_plain, r2)]:
+        pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+        pred.predict_file(reader, ws["paths"]["test"], path)
+    recs1 = [r for l in r1.read_text().splitlines() for r in json.loads(l)]
+    recs2 = [r for l in r2.read_text().splitlines() for r in json.loads(l)]
+    assert len(recs1) == len(recs2)
+    for a, b in zip(recs1, recs2):
+        assert a["Issue_Url"] == b["Issue_Url"]
+        for anchor in a["predict"]:
+            np.testing.assert_allclose(
+                a["predict"][anchor], b["predict"][anchor], rtol=1e-4, atol=1e-5
+            )
+
+
+def test_cal_metrics_perfect_and_inverted(tmp_path):
+    # synthetic result file with known outcomes
+    records = [
+        {"Issue_Url": "u1", "label": "CWE-79", "predict": {"a": 0.9, "b": 0.2}},
+        {"Issue_Url": "u2", "label": "neg", "predict": {"a": 0.1, "b": 0.3}},
+        {"Issue_Url": "u3", "label": "neg", "predict": {"a": 0.6, "b": 0.1}},
+    ]
+    f = tmp_path / "m_result.json"
+    f.write_text(json.dumps(records))
+    m = cal_metrics(f, thres=0.5)
+    assert (m["TP"], m["FN"], m["TN"], m["FP"]) == (1, 0, 1, 1)
+    assert (tmp_path / "m_metric_all.json").exists()
+    m2 = cal_metrics(f, thres=0.7)
+    assert (m2["TP"], m2["FN"], m2["TN"], m2["FP"]) == (1, 0, 2, 0)
+    assert m2["f1"] == 1.0
+
+
+def test_single_model_eval_pipeline(ws, tmp_path):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = SingleModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy)
+    out = tmp_path / "single_result.json"
+    metrics = run_single_eval(
+        model, params, ws["tokenizer"],
+        test_file=ws["paths"]["test"],
+        out_results=out,
+        out_metrics=tmp_path / "single_metric_all.json",
+        reader=SingleReader(),
+        batch_size=16,
+        max_length=64,
+    )
+    records = [r for l in out.read_text().splitlines() for r in json.loads(l)]
+    test_corpus = json.loads(open(ws["paths"]["test"]).read())
+    assert len(records) == len(test_corpus)
+    assert set(records[0]) == {"Issue_Url", "label", "predict", "prob"}
+    assert metrics["TP"] + metrics["FN"] == sum(
+        1 for r in records if r["label"] != "neg"
+    )
+
+
+def test_cal_metrics_empty_result_file(tmp_path):
+    f = tmp_path / "empty_result.json"
+    f.write_text("")
+    m = cal_metrics(f, thres=0.5)
+    assert m["f1"] == 0.0 and m["TP"] == 0

@@ -1,0 +1,87 @@
+import numpy as np
+import pytest
+
+from memvul_tpu.training.metrics import (
+    RunningClassification,
+    SiameseMeasure,
+    binary_confusion,
+    find_best_threshold,
+    model_measure,
+)
+
+
+def test_binary_confusion():
+    labels = [1, 1, 0, 0, 1]
+    preds = [1, 0, 0, 1, 1]
+    assert binary_confusion(labels, preds) == (2, 1, 1, 1)
+
+
+def test_model_measure_against_sklearn():
+    from sklearn import metrics as skm
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 200)
+    scores = np.clip(labels * 0.6 + rng.normal(0, 0.3, 200), 0, 1)
+    preds = (scores >= 0.5).astype(int)
+    m = model_measure(labels, preds, scores)
+    assert m["TP"] + m["FN"] == labels.sum()
+    np.testing.assert_allclose(m["auc"], skm.roc_auc_score(labels, scores))
+    np.testing.assert_allclose(
+        m["ap"], skm.average_precision_score(labels, scores)
+    )
+    expected_f1 = skm.f1_score(labels, preds)
+    np.testing.assert_allclose(m["f1"], expected_f1)
+
+
+def test_find_best_threshold_prefers_higher_on_ties():
+    # perfectly separable: any threshold in (0.3, 0.95) gives f1=1;
+    # ties resolve to the highest swept threshold below 0.95
+    labels = [0, 0, 1, 1]
+    scores = [0.1, 0.3, 0.95, 0.99]
+    best = find_best_threshold(labels, scores)
+    assert best["f1"] == 1.0
+    assert best["thres"] == pytest.approx(0.89)
+
+
+def test_find_best_threshold_range_bounds():
+    labels = [1, 0]
+    scores = [0.45, 0.2]  # positive below sweep range -> F1 0 everywhere
+    best = find_best_threshold(labels, scores)
+    assert best["f1"] == 0.0
+
+
+def test_siamese_measure_lifecycle():
+    m = SiameseMeasure()
+    assert m.compute()["f1"] == 0.0  # empty -> zeros (train-time no-op)
+    m.update([0.9, 0.2], [{"label": "CWE-79"}, {"label": "neg"}])
+    m.update([0.8], [{"label": "CWE-89"}])
+    assert len(m) == 3
+    out = m.compute(reset=True)
+    assert out["f1"] == 1.0
+    assert out["auc"] == 1.0
+    assert len(m) == 0  # reset cleared
+
+
+def test_running_classification_matches_sklearn():
+    from sklearn import metrics as skm
+
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 2, 300)
+    preds = rng.integers(0, 2, 300)
+    rc = RunningClassification(2, ["same", "diff"])
+    # stream in chunks with a padding row at the end
+    for i in range(0, 300, 100):
+        rc.update(preds[i : i + 100], labels[i : i + 100])
+    rc.update([1], [0], weights=[0.0])  # dead row must be ignored
+    out = rc.compute()
+    np.testing.assert_allclose(out["accuracy"], skm.accuracy_score(labels, preds))
+    p, r, f, _ = skm.precision_recall_fscore_support(
+        labels, preds, average="weighted", zero_division=0
+    )
+    np.testing.assert_allclose(out["precision"], p)
+    np.testing.assert_allclose(out["f1-score"], f)
+    p_each, r_each, f_each, _ = skm.precision_recall_fscore_support(
+        labels, preds, average=None, zero_division=0
+    )
+    np.testing.assert_allclose(out["same_f1-score"], f_each[0])
+    np.testing.assert_allclose(out["diff_recall"], r_each[1])

@@ -236,3 +236,22 @@ def test_ring_impl_raises_with_guidance():
     q = jnp.zeros((1, 4, 2, 8))
     with pytest.raises(ValueError, match="shard_map"):
         dot_product_attention(q, q, q, impl="ring")
+
+
+def test_pooler_dropout_active_in_training(rng):
+    model = MemoryModel(CFG, use_header=False)
+    s1 = token_batch(rng)
+    params = model.init(jax.random.PRNGKey(0), s1)
+    det = model.apply(params, s1)
+    stoch = model.apply(
+        params, s1, deterministic=False, rngs={"dropout": jax.random.PRNGKey(9)}
+    )
+    assert not np.allclose(det, stoch)  # pooled path is regularized
+
+
+def test_overlong_sequence_raises(rng):
+    enc = BertEncoder(CFG)  # tiny: max_position_embeddings=128
+    ids = jnp.zeros((2, 200), jnp.int32)
+    mask = jnp.ones((2, 200), jnp.int32)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        enc.init(jax.random.PRNGKey(0), ids, mask)
