@@ -54,16 +54,20 @@ def batch_spec(mesh: Mesh) -> P:
     return P(DATA_AXIS) if DATA_AXIS in mesh.axis_names else P()
 
 
-def shard_batch(batch, mesh: Mesh):
-    """Device-put a pytree of [B, ...] arrays, batch dim sharded over
-    ``data`` when the mesh has that axis; scalars and non-array leaves
-    (metadata) pass through untouched."""
+def shard_batch(batch, mesh: Mesh, batch_axis: int = 0):
+    """Device-put a pytree of arrays with dimension ``batch_axis`` sharded
+    over ``data`` when the mesh has that axis; arrays too small for the
+    axis, scalars, and non-array leaves (metadata) pass through
+    replicated/untouched.  ``batch_axis=1`` shards a [K, B, ...] microbatch
+    stack on its B dimension."""
     has_data_axis = DATA_AXIS in mesh.axis_names
 
     def put(x):
         if isinstance(x, (np.ndarray, jax.Array)):
-            if has_data_axis and x.ndim >= 1:
-                spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
+            if has_data_axis and x.ndim > batch_axis:
+                axes = [None] * x.ndim
+                axes[batch_axis] = DATA_AXIS
+                spec = P(*axes)
             else:
                 spec = P()
             return jax.device_put(x, NamedSharding(mesh, spec))

@@ -1,6 +1,9 @@
+from .checkpoint import MetricTracker, TrainCheckpointer  # noqa: F401
 from .metrics import (  # noqa: F401
     SiameseMeasure,
     binary_confusion,
     find_best_threshold,
     model_measure,
 )
+from .optim import linear_with_warmup, make_optimizer  # noqa: F401
+from .trainer import MemoryTrainer, TrainerConfig  # noqa: F401

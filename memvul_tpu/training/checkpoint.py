@@ -1,0 +1,134 @@
+"""Checkpoint/resume via orbax.
+
+The reference checkpoints model+training state every epoch, keeps the
+best by validation metric, and restores epoch/optimizer/metric-tracker
+state on resume (reference: custom_trainer.py:668-672,746-754,787-867).
+Note the anchor-bank embeddings are derived state and are NOT persisted —
+they are recomputed from anchor text after every restore, matching the
+reference (model_memory.py:76-77, predict_memory.py:78-83).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class TrainCheckpointer:
+    """Tracks 'latest' and 'best' training state under one directory."""
+
+    def __init__(self, directory: Union[str, Path], max_to_keep: int = 1) -> None:
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self._manager = ocp.CheckpointManager(
+            self.directory / "epochs",
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+        self._best_dir = self.directory / "best"
+
+    # -- per-epoch state -----------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state: Dict[str, Any],
+        is_best: bool = False,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self._manager.save(step, args=ocp.args.StandardSave(state))
+        self._manager.wait_until_finished()
+        if metadata is not None:
+            (self.directory / f"metrics_epoch_{step}.json").write_text(
+                json.dumps(metadata, indent=2, default=float)
+            )
+        if is_best:
+            ckptr = ocp.StandardCheckpointer()
+            best_path = self._best_dir
+            if best_path.exists():
+                import shutil
+
+                shutil.rmtree(best_path)
+            ckptr.save(best_path, state)
+            ckptr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager.latest_step()
+
+    def restore_latest(
+        self, template: Dict[str, Any]
+    ) -> Optional[Tuple[int, Dict[str, Any]]]:
+        step = self._manager.latest_step()
+        if step is None:
+            return None
+        restored = self._manager.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        return step, restored
+
+    def restore_best(self, template: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        if not self._best_dir.exists():
+            return None
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(self._best_dir, template)
+
+    def close(self) -> None:
+        self._manager.close()
+
+
+class MetricTracker:
+    """Best-metric tracking + patience-based early stopping.
+
+    ``spec`` is the reference's signed-metric string, e.g. ``"+s_f1-score"``
+    (higher is better) or ``"-loss"`` (reference: config_memory.json:102,
+    custom_trainer.py:207,709-710).
+    """
+
+    def __init__(self, spec: str, patience: Optional[int] = None) -> None:
+        if spec[0] not in "+-":
+            raise ValueError(f"metric spec must start with +/-: {spec!r}")
+        self.sign = 1.0 if spec[0] == "+" else -1.0
+        self.name = spec[1:]
+        self.patience = patience
+        self.best: Optional[float] = None
+        self.best_epoch: Optional[int] = None
+        self.epochs_without_improvement = 0
+
+    def update(self, metrics: Dict[str, float], epoch: int) -> bool:
+        """Returns True when this epoch is the new best.  ``best`` stores
+        the raw (unsigned) metric value."""
+        if self.name not in metrics:
+            raise KeyError(
+                f"validation metric {self.name!r} missing from {sorted(metrics)}"
+            )
+        value = float(metrics[self.name])
+        if self.best is None or self.sign * value > self.sign * self.best:
+            self.best = value
+            self.best_epoch = epoch
+            self.epochs_without_improvement = 0
+            return True
+        self.epochs_without_improvement += 1
+        return False
+
+    def should_stop(self) -> bool:
+        return (
+            self.patience is not None
+            and self.epochs_without_improvement >= self.patience
+        )
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "best": self.best,
+            "best_epoch": self.best_epoch,
+            "epochs_without_improvement": self.epochs_without_improvement,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.best = state["best"]
+        self.best_epoch = state["best_epoch"]
+        self.epochs_without_improvement = state["epochs_without_improvement"]

@@ -1,0 +1,101 @@
+"""Optimizers — parameter-group AdamW + linear warmup, in optax.
+
+The reference uses HF AdamW with parameter groups (embedder lr 2e-5,
+pooler lr 5e-5, everything else lr 1e-4) and a linear-with-warmup
+schedule (warmup 10000) plus grad-norm clipping
+(reference: MemVul/config_memory.json:60-75, custom_trainer.py:263-277).
+
+Here parameter groups are expressed as path-prefix rules mapped through
+``optax.multi_transform``; the warmup/decay schedule is a shared scale so
+each group keeps its own base learning rate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import optax
+
+
+def linear_with_warmup(
+    warmup_steps: int, total_steps: Optional[int] = None
+) -> optax.Schedule:
+    """0→1 linearly over ``warmup_steps``, then (if ``total_steps``) decay
+    linearly to 0 — HF/AllenNLP's ``linear_with_warmup``; without
+    ``total_steps`` the scale stays at 1 after warmup."""
+
+    def schedule(step):
+        import jax.numpy as jnp
+
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.minimum(1.0, step / jnp.maximum(1.0, float(warmup_steps)))
+        if total_steps is None:
+            return warm
+        decay = jnp.maximum(
+            0.0,
+            (total_steps - step) / jnp.maximum(1.0, float(total_steps - warmup_steps)),
+        )
+        return jnp.where(step < warmup_steps, warm, decay)
+
+    return schedule
+
+
+def label_params_by_prefix(
+    params, rules: Sequence[Tuple[str, str]], default: str = "default"
+):
+    """Assign each param leaf a group label by first matching path rule.
+
+    ``rules``: (substring, label) pairs checked in order against the
+    ``/``-joined parameter path.
+    """
+
+    def label(path, _):
+        path_str = "/".join(str(getattr(k, "key", k)) for k in path)
+        for needle, name in rules:
+            if needle in path_str:
+                return name
+        return default
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def make_optimizer(
+    params,
+    group_lrs: Optional[Dict[str, float]] = None,
+    group_rules: Optional[Sequence[Tuple[str, str]]] = None,
+    base_lr: float = 1e-4,
+    warmup_steps: int = 0,
+    total_steps: Optional[int] = None,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    weight_decay: float = 0.0,
+    grad_clip_norm: Optional[float] = 1.0,
+) -> Tuple[optax.GradientTransformation, object]:
+    """Build the reference's optimizer stack.
+
+    Default groups mirror config_memory.json:60-68: the BERT encoder at
+    2e-5, the pooler at 5e-5, heads at ``base_lr``.
+    Returns (optimizer, opt_state).
+    """
+    if group_rules is None:
+        group_rules = (("bert/", "embedder"), ("pooler/", "pooler"))
+    if group_lrs is None:
+        group_lrs = {"embedder": 2e-5, "pooler": 5e-5}
+    schedule = linear_with_warmup(warmup_steps, total_steps) if warmup_steps else None
+
+    def adamw(lr: float) -> optax.GradientTransformation:
+        chain = [optax.scale_by_adam(b1=betas[0], b2=betas[1])]
+        if weight_decay:
+            chain.append(optax.add_decayed_weights(weight_decay))
+        if schedule is not None:
+            chain.append(optax.scale_by_schedule(schedule))
+        chain.append(optax.scale(-lr))
+        return optax.chain(*chain)
+
+    transforms = {name: adamw(lr) for name, lr in group_lrs.items()}
+    transforms["default"] = adamw(base_lr)
+    labels = label_params_by_prefix(params, group_rules)
+    tx = optax.multi_transform(transforms, labels)
+    if grad_clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx, tx.init(params)

@@ -1,0 +1,352 @@
+"""The training loop for the Siamese memory model.
+
+Reference counterpart: ``CustomGradientDescentTrainer``
+(MemVul/custom_trainer.py) driving per-epoch hooks.  The semantics kept:
+
+* **online sampling** — the pair stream is re-rolled every epoch (the
+  reference's ``reset_dataloader`` callback, callbacks.py:16-25; here the
+  reader is simply re-read, which re-rolls its RNG draws);
+* **anchor re-encode before validation** — after each train epoch the
+  anchor bank is re-encoded with the *current* weights, then validation
+  matches against it (the ``custom_validation`` callback + ordering at
+  custom_trainer.py:681-683);
+* gradient accumulation, grad-norm clipping, warmup schedule, NaN guard,
+  patience-based early stopping on ``+s_f1-score``, best-model selection,
+  checkpoint/resume.
+
+TPU redesign: one jitted ``train_step`` takes a *stack* of K microbatches
+[K, B, L] and folds gradient accumulation into ``lax.scan`` — a single
+device program per optimizer step.  Under a mesh the batch is sharded on
+the ``data`` axis and params are replicated; XLA inserts the gradient
+all-reduce over ICI (no DDP machinery, no done-flag collectives —
+batches are fixed-shape by construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import tempfile
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.batching import LABELS_SIAMESE, CachedEncoder, batches_from_instances, prefetch
+from ..data.readers import MemoryReader
+from ..models.memory import MemoryModel, pair_loss
+from ..parallel.mesh import replicate, shard_batch
+from .checkpoint import MetricTracker, TrainCheckpointer
+from .metrics import RunningClassification
+from .optim import make_optimizer
+
+logger = logging.getLogger(__name__)
+
+
+def make_train_step(model: MemoryModel, tx):
+    """Build the fused optimizer step: grad accumulation over a [K, B, ...]
+    microbatch stack via ``lax.scan``, then one parameter-group AdamW
+    update.  Shared by :class:`MemoryTrainer` and the driver's multi-chip
+    dryrun so both compile the same program."""
+    temperature = model.temperature
+
+    def loss_fn(params, microbatch, rng):
+        logits = model.apply(
+            params,
+            microbatch["sample1"],
+            microbatch["sample2"],
+            deterministic=False,
+            rngs={"dropout": rng},
+        )
+        loss = pair_loss(
+            logits, microbatch["label"], microbatch["weight"], temperature
+        )
+        return loss, logits
+
+    def train_step(params, opt_state, stack, rng):
+        def accumulate(carry, microbatch):
+            grads_sum, loss_sum, rng = carry
+            rng, sub = jax.random.split(rng)
+            (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, microbatch, sub
+            )
+            grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
+            return (grads_sum, loss_sum + loss, rng), logits
+
+        zero_grads = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), params)
+        (grads, loss_sum, _), logits = jax.lax.scan(
+            accumulate, (zero_grads, 0.0, rng), stack
+        )
+        k = stack["label"].shape[0]
+        grads = jax.tree_util.tree_map(lambda g: g / k, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates
+        )
+        return params, opt_state, loss_sum / k, logits
+
+    return train_step
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    num_epochs: int = 30
+    patience: Optional[int] = 10
+    validation_metric: str = "+s_f1-score"
+    batch_size: int = 32
+    grad_accum: int = 2
+    max_length: int = 256
+    eval_batch_size: int = 512
+    eval_max_length: int = 512
+    warmup_steps: int = 10000
+    total_steps: Optional[int] = None  # enables linear decay after warmup
+    base_lr: float = 1e-4
+    group_lrs: Optional[Dict[str, float]] = None
+    grad_clip_norm: Optional[float] = 1.0
+    weight_decay: float = 0.0
+    seed: int = 2021
+    serialization_dir: Optional[str] = None
+    keep_checkpoints: int = 1
+    steps_per_epoch: Optional[int] = None  # cap (useful for tests/smoke)
+
+
+class MemoryTrainer:
+    def __init__(
+        self,
+        model: MemoryModel,
+        params,
+        tokenizer,
+        reader: MemoryReader,
+        train_path: Union[str, Path],
+        validation_path: Optional[Union[str, Path]] = None,
+        anchor_path: Optional[Union[str, Path]] = None,
+        config: Optional[TrainerConfig] = None,
+        mesh=None,
+    ) -> None:
+        self.model = model
+        self.config = config or TrainerConfig()
+        self.tokenizer = tokenizer
+        self.reader = reader
+        self.train_path = str(train_path)
+        self.validation_path = str(validation_path) if validation_path else None
+        self.anchor_path = str(anchor_path) if anchor_path else None
+        self.mesh = mesh
+
+        c = self.config
+        self.encoder = CachedEncoder(tokenizer, max_length=c.max_length)
+        total_steps = c.total_steps
+        if total_steps is None and c.steps_per_epoch is not None:
+            # the reference wires total steps as epochs × steps-per-epoch so
+            # the warmup schedule decays to 0 (custom_trainer.py:949)
+            total_steps = c.num_epochs * c.steps_per_epoch
+        self.tx, opt_state = make_optimizer(
+            params,
+            group_lrs=c.group_lrs,
+            base_lr=c.base_lr,
+            warmup_steps=c.warmup_steps,
+            total_steps=total_steps,
+            grad_clip_norm=c.grad_clip_norm,
+            weight_decay=c.weight_decay,
+        )
+        if mesh is not None:
+            params = replicate(params, mesh)
+            opt_state = replicate(opt_state, mesh)
+        self.params = params
+        self.opt_state = opt_state
+        self.rng = jax.random.PRNGKey(c.seed)
+        self.step = 0
+        self.epoch = 0
+        self.tracker = MetricTracker(c.validation_metric, c.patience)
+        self.checkpointer = (
+            TrainCheckpointer(c.serialization_dir, c.keep_checkpoints)
+            if c.serialization_dir
+            else None
+        )
+        self.metrics_history: List[Dict[str, Any]] = []
+        self._train_step = jax.jit(make_train_step(self.model, self.tx))
+
+    # -- data ----------------------------------------------------------------
+
+    def _microbatch_stacks(self) -> Iterator[Dict]:
+        """Group the epoch's pair stream into [K, B, L] stacks."""
+        c = self.config
+        batches = batches_from_instances(
+            self.reader.read(self.train_path, split="train"),
+            self.encoder,
+            batch_size=c.batch_size,
+            label_map=LABELS_SIAMESE,
+            pad_to_max=True,  # single shape → single compiled program
+        )
+        group: List[Dict] = []
+        for batch in prefetch(batches, depth=8):
+            batch.pop("meta", None)
+            group.append(batch)
+            if len(group) == c.grad_accum:
+                yield self._stack(group)
+                group = []
+        if group:
+            # pad the final ragged group with zero-weight copies
+            while len(group) < c.grad_accum:
+                dead = jax.tree_util.tree_map(np.copy, group[-1])
+                dead["weight"] = np.zeros_like(dead["weight"])
+                group.append(dead)
+            yield self._stack(group)
+
+    def _stack(self, group: List[Dict]) -> Dict:
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs, axis=0), *group
+        )
+        if self.mesh is not None:
+            # shard the batch dim (axis 1 of the [K, B, ...] stack)
+            stacked = shard_batch(stacked, self.mesh, batch_axis=1)
+        return stacked
+
+    # -- epoch orchestration ---------------------------------------------------
+
+    def train_epoch(self) -> Dict[str, float]:
+        c = self.config
+        running = RunningClassification(2, ["same", "diff"])
+        losses: List[float] = []
+        started = time.perf_counter()
+        for i, stack in enumerate(self._microbatch_stacks()):
+            if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
+                break
+            self.rng, step_rng = jax.random.split(self.rng)
+            self.params, self.opt_state, loss, logits = self._train_step(
+                self.params, self.opt_state, stack, step_rng
+            )
+            loss = float(loss)
+            if np.isnan(loss):
+                raise FloatingPointError(f"NaN loss at step {self.step}")
+            losses.append(loss)
+            preds = np.asarray(logits.argmax(axis=-1)).reshape(-1)
+            labels = np.asarray(stack["label"]).reshape(-1)
+            weights = np.asarray(stack["weight"]).reshape(-1)
+            running.update(preds, labels, weights)
+            self.step += 1
+        metrics = running.compute()
+        metrics["loss"] = float(np.mean(losses)) if losses else 0.0
+        metrics["epoch_seconds"] = time.perf_counter() - started
+        metrics["num_steps"] = len(losses)
+        return metrics
+
+    def validate(self) -> Dict[str, float]:
+        """Anchor re-encode with current weights, then validation scoring —
+        the custom-callbacks-before-validation contract
+        (reference: custom_trainer.py:681-683, callbacks.py:28-53)."""
+        if not (self.validation_path and self.anchor_path):
+            return {}
+        c = self.config
+        if not hasattr(self, "_val_predictor"):
+            # local import: evaluate.predict_memory ↔ training would
+            # otherwise form an import cycle
+            from ..evaluate.predict_memory import SiamesePredictor
+
+            self._val_predictor = SiamesePredictor(
+                self.model,
+                self.params,
+                self.tokenizer,
+                mesh=self.mesh,
+                batch_size=c.eval_batch_size,
+                max_length=c.eval_max_length,
+            )
+        predictor = self._val_predictor
+        predictor.params = self.params  # current weights, compiled fns reused
+        predictor.encode_anchors(self.reader.read_anchors(self.anchor_path))
+        out_dir = (
+            Path(c.serialization_dir)
+            if c.serialization_dir
+            else Path(tempfile.mkdtemp(prefix="memvul_val_"))
+        )
+        out = out_dir / f"validation_epoch_{self.epoch}.json"
+        metrics = predictor.predict_file(
+            self.reader, self.validation_path, out, split="validation"
+        )
+        # reference metric names (model_memory.py:210-215)
+        rename = {"f1": "s_f1-score"}
+        return {
+            rename.get(k, f"s_{k}"): v for k, v in metrics.items()
+        }
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        self.maybe_restore()
+        while self.epoch < c.num_epochs:
+            epoch_metrics = {"epoch": self.epoch}
+            epoch_metrics.update(
+                {f"training_{k}": v for k, v in self.train_epoch().items()}
+            )
+            val = self.validate()
+            epoch_metrics.update({f"validation_{k}": v for k, v in val.items()})
+            self.metrics_history.append(epoch_metrics)
+            logger.info("epoch %d: %s", self.epoch, epoch_metrics)
+
+            is_best = True
+            if val:
+                is_best = self.tracker.update(
+                    {k.replace("validation_", ""): v for k, v in epoch_metrics.items()
+                     if k.startswith("validation_")},
+                    self.epoch,
+                )
+            if self.checkpointer is not None:
+                self.checkpointer.save(
+                    self.epoch,
+                    self._state_dict(),
+                    is_best=is_best,
+                    metadata=epoch_metrics,
+                )
+            self.epoch += 1
+            if val and self.tracker.should_stop():
+                logger.info("early stopping at epoch %d", self.epoch)
+                break
+        return {
+            "best_epoch": self.tracker.best_epoch,
+            "best_validation": self.tracker.best,
+            "history": self.metrics_history,
+        }
+
+    # -- state ----------------------------------------------------------------
+
+    def _state_dict(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "rng": jax.device_get(self.rng),
+            "meta": {
+                "step": self.step,
+                "epoch": self.epoch,
+                "tracker": self.tracker.state_dict(),
+            },
+        }
+
+    def maybe_restore(self) -> bool:
+        if self.checkpointer is None:
+            return False
+        restored = self.checkpointer.restore_latest(self._state_dict())
+        if restored is None:
+            return False
+        _, state = restored
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.rng = jnp.asarray(state["rng"])
+        meta = state["meta"]
+        self.step = int(meta["step"])
+        self.epoch = int(meta["epoch"]) + 1  # resume after the saved epoch
+        tracker_state = dict(meta["tracker"])
+        self.tracker.load_state_dict(tracker_state)
+        if self.mesh is not None:
+            self.params = replicate(self.params, self.mesh)
+            self.opt_state = replicate(self.opt_state, self.mesh)
+        logger.info("restored checkpoint at epoch %d", self.epoch - 1)
+        return True
+
+    def best_params(self):
+        """Reload the best-by-validation params (reference:
+        custom_trainer.py:779-784)."""
+        if self.checkpointer is None:
+            return self.params
+        state = self.checkpointer.restore_best(self._state_dict())
+        return state["params"] if state is not None else self.params

@@ -1,0 +1,69 @@
+import numpy as np
+
+from memvul_tpu.models.folding import fold_tokens, unfold_embeddings
+
+CLS, SEP, PAD = 2, 3, 0
+
+
+def frame(tokens, total):
+    """[CLS] tokens [SEP] padded to total."""
+    ids = np.full(total, PAD, dtype=np.int32)
+    seq = [CLS] + list(tokens) + [SEP]
+    ids[: len(seq)] = seq
+    mask = (ids != PAD).astype(np.int32)
+    return ids, mask
+
+
+def test_short_input_single_segment():
+    ids, mask = frame([10, 11, 12], 16)
+    folded, fmask, s = fold_tokens(
+        ids[None], mask[None], max_length=16, cls_id=CLS, sep_id=SEP, pad_id=PAD
+    )
+    assert s == 1
+    assert folded[0, 0] == CLS
+    content = folded[0][fmask[0] > 0]
+    assert content.tolist() == [CLS, 10, 11, 12, SEP]
+
+
+def test_long_input_folds_and_reframes():
+    tokens = list(range(10, 30))  # 20 content tokens
+    ids, mask = frame(tokens, 32)
+    max_length = 10  # inner 8 -> ceil((32-1)/8) segments
+    folded, fmask, s = fold_tokens(
+        ids[None], mask[None], max_length=max_length,
+        cls_id=CLS, sep_id=SEP, pad_id=PAD,
+    )
+    assert folded.shape == (s, max_length)
+    # every non-empty segment is CLS-framed and SEP-terminated
+    for i in range(s):
+        if fmask[i].sum() == 0:
+            continue
+        seg = folded[i][fmask[i] > 0]
+        assert seg[0] == CLS and seg[-1] == SEP
+    # all content tokens survive exactly once, in order
+    recovered = [
+        t
+        for i in range(s)
+        for t in folded[i][fmask[i] > 0][1:-1].tolist()
+    ]
+    assert recovered == tokens
+
+
+def test_batch_folding_shapes():
+    a_ids, a_mask = frame(list(range(10, 40)), 40)
+    b_ids, b_mask = frame([50], 40)
+    ids = np.stack([a_ids, b_ids])
+    mask = np.stack([a_mask, b_mask])
+    folded, fmask, s = fold_tokens(ids, mask, 12, CLS, SEP, PAD)
+    assert folded.shape[0] == 2 * s
+
+
+def test_unfold_embeddings_roundtrip_shape():
+    bs, length, dim = 6, 10, 4
+    emb = np.arange(bs * length * dim, dtype=np.float32).reshape(bs, length, dim)
+    out = unfold_embeddings(emb, num_segments=3)
+    assert out.shape == (2, 3 * (length - 2), dim)
+    # the first stitched row of report 0 is segment 0 position 1
+    np.testing.assert_array_equal(out[0, 0], emb[0, 1])
+    # the first row of the second segment follows the last of the first
+    np.testing.assert_array_equal(out[0, length - 2], emb[1, 1])

@@ -1,0 +1,236 @@
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.parallel import create_mesh
+from memvul_tpu.training import (
+    MemoryTrainer,
+    MetricTracker,
+    TrainerConfig,
+    linear_with_warmup,
+    make_optimizer,
+)
+from memvul_tpu.training.optim import label_params_by_prefix
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("train"), seed=5)
+
+
+def make_trainer(ws, tmp_path, mesh=None, **cfg_kw):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"],
+        anchor_path=ws["paths"]["anchors"],
+        same_diff_ratio={"same": 2, "diff": 2},
+        sample_neg=0.5,
+        seed=2021,
+    )
+    defaults = dict(
+        num_epochs=2,
+        patience=None,
+        batch_size=4,
+        grad_accum=2,
+        max_length=32,
+        eval_batch_size=8,
+        eval_max_length=32,
+        warmup_steps=2,
+        base_lr=1e-3,
+        serialization_dir=str(tmp_path / "out"),
+    )
+    defaults.update(cfg_kw)
+    trainer = MemoryTrainer(
+        model,
+        params,
+        ws["tokenizer"],
+        reader,
+        train_path=ws["paths"]["train"],
+        validation_path=ws["paths"]["validation"],
+        anchor_path=ws["paths"]["anchors"],
+        config=TrainerConfig(**defaults),
+        mesh=mesh,
+    )
+    return trainer
+
+
+# -- optimizer ----------------------------------------------------------------
+
+
+def test_linear_with_warmup_schedule():
+    s = linear_with_warmup(10, total_steps=100)
+    assert float(s(0)) == 0.0
+    assert float(s(5)) == pytest.approx(0.5)
+    assert float(s(10)) == pytest.approx(1.0)
+    assert float(s(55)) == pytest.approx(0.5)
+    assert float(s(100)) == pytest.approx(0.0)
+    s2 = linear_with_warmup(10)
+    assert float(s2(1000)) == 1.0
+
+
+def test_param_group_labels():
+    params = {
+        "params": {
+            "bert": {"layer_0": {"kernel": np.zeros(1)}},
+            "pooler": {"dense": {"kernel": np.zeros(1)}},
+            "pair_kernel": np.zeros(1),
+        }
+    }
+    labels = label_params_by_prefix(
+        params, (("bert/", "embedder"), ("pooler/", "pooler"))
+    )
+    assert labels["params"]["bert"]["layer_0"]["kernel"] == "embedder"
+    assert labels["params"]["pooler"]["dense"]["kernel"] == "pooler"
+    assert labels["params"]["pair_kernel"] == "default"
+
+
+def test_group_learning_rates_applied():
+    params = {
+        "params": {
+            "bert": {"kernel": jnp.ones(4)},
+            "pooler": {"kernel": jnp.ones(4)},
+            "head": {"kernel": jnp.ones(4)},
+        }
+    }
+    tx, state = make_optimizer(
+        params,
+        group_lrs={"embedder": 1e-5, "pooler": 1e-4},
+        base_lr=1e-2,
+        warmup_steps=0,
+        grad_clip_norm=None,
+    )
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, _ = tx.update(grads, state, params)
+    # adam step size == lr for constant unit grads at step 1 (approx)
+    assert abs(updates["params"]["bert"]["kernel"][0]) < abs(
+        updates["params"]["pooler"]["kernel"][0]
+    )
+    assert abs(updates["params"]["pooler"]["kernel"][0]) < abs(
+        updates["params"]["head"]["kernel"][0]
+    )
+
+
+# -- metric tracker -----------------------------------------------------------
+
+
+def test_metric_tracker_patience():
+    t = MetricTracker("+s_f1-score", patience=2)
+    assert t.update({"s_f1-score": 0.5}, 0) is True
+    assert t.update({"s_f1-score": 0.4}, 1) is False
+    assert not t.should_stop()
+    assert t.update({"s_f1-score": 0.3}, 2) is False
+    assert t.should_stop()
+    assert t.best_epoch == 0
+
+
+def test_metric_tracker_minimize():
+    t = MetricTracker("-loss", patience=None)
+    assert t.update({"loss": 1.0}, 0)
+    assert t.update({"loss": 0.5}, 1)
+    assert not t.update({"loss": 0.7}, 2)
+
+
+def test_metric_tracker_bad_spec():
+    with pytest.raises(ValueError):
+        MetricTracker("s_f1-score")
+    t = MetricTracker("+x")
+    with pytest.raises(KeyError):
+        t.update({"y": 1.0}, 0)
+
+
+# -- trainer end-to-end -------------------------------------------------------
+
+
+def test_trainer_runs_and_tracks(ws, tmp_path):
+    trainer = make_trainer(ws, tmp_path, steps_per_epoch=4)
+    result = trainer.train()
+    assert len(result["history"]) == 2
+    first = result["history"][0]
+    assert "training_loss" in first and np.isfinite(first["training_loss"])
+    assert "validation_s_f1" in first or "validation_s_f1-score" in str(first)
+    # checkpoint + metrics file written
+    out = tmp_path / "out"
+    assert (out / "metrics_epoch_0.json").exists()
+    assert result["best_epoch"] is not None
+
+
+def test_trainer_loss_decreases_on_overfit(ws, tmp_path):
+    trainer = make_trainer(
+        ws,
+        tmp_path,
+        num_epochs=5,
+        steps_per_epoch=6,
+        base_lr=5e-3,
+        warmup_steps=1,
+        serialization_dir=None,
+    )
+    result = trainer.train()
+    losses = [h["training_loss"] for h in result["history"]]
+    assert losses[-1] < losses[0]
+
+
+def test_trainer_resume(ws, tmp_path):
+    t1 = make_trainer(ws, tmp_path, num_epochs=1, steps_per_epoch=2)
+    t1.train()
+    t2 = make_trainer(ws, tmp_path, num_epochs=2, steps_per_epoch=2)
+    assert t2.maybe_restore() is True
+    assert t2.epoch == 1
+    assert t2.step == t1.step
+    # params actually restored (identical leaves)
+    l1 = jax.tree_util.tree_leaves(jax.device_get(t1.params))
+    l2 = jax.tree_util.tree_leaves(jax.device_get(t2.params))
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trainer_sharded_step(ws, tmp_path):
+    mesh = create_mesh()
+    trainer = make_trainer(
+        ws, tmp_path, mesh=mesh, batch_size=8, steps_per_epoch=2,
+        num_epochs=1, serialization_dir=None,
+    )
+    result = trainer.train()
+    assert np.isfinite(result["history"][0]["training_loss"])
+
+
+def test_metric_tracker_minimize_stores_raw_value():
+    t = MetricTracker("-loss")
+    t.update({"loss": 0.42}, 0)
+    assert t.best == pytest.approx(0.42)  # raw, not negated
+
+
+def test_total_steps_decay_wired_from_steps_per_epoch(ws, tmp_path):
+    trainer = make_trainer(
+        ws, tmp_path, num_epochs=2, steps_per_epoch=3, warmup_steps=1,
+        serialization_dir=None,
+    )
+    # schedule decays to 0 at total_steps = 6
+    from memvul_tpu.training import linear_with_warmup
+
+    s = linear_with_warmup(1, total_steps=6)
+    assert float(s(6)) == 0.0
+
+
+def test_fold_tokens_does_not_mutate_inputs():
+    from memvul_tpu.models.folding import fold_tokens
+
+    ids = np.array([[2, 10, 11, 3, 0, 0]], dtype=np.int32)
+    mask = (ids != 0).astype(np.int32)
+    ids_before, mask_before = ids.copy(), mask.copy()
+    fold_tokens(ids, mask, max_length=6, cls_id=2, sep_id=3, pad_id=0)
+    np.testing.assert_array_equal(ids, ids_before)
+    np.testing.assert_array_equal(mask, mask_before)
