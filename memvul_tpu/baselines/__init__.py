@@ -1,0 +1,1 @@
+from .sklearn_baseline import run_baselines  # noqa: F401

@@ -1,0 +1,109 @@
+"""Classical ML baselines (CPU, sklearn).
+
+Reference (Baseline/baseline.py + dimension_reduce.py): bag-of-words
+CountVectorizer with English stop words, L1 LinearSVC (C=0.3) feature
+selection, then five learners — RandomForest (30 trees, OOB),
+MultinomialNB, MLP (max_iter 10), LogisticRegression, KNN — each
+emitting ``{learner}_result.json`` + ``{learner}_metric.json`` with the
+same measure dict as the neural paths.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..training.metrics import model_measure
+
+logger = logging.getLogger(__name__)
+
+
+def _texts_and_labels(samples: Sequence[Dict], target: str) -> Tuple[List[str], np.ndarray]:
+    texts, labels = [], []
+    for s in samples:
+        texts.append(f"{s.get('Issue_Title') or ''}. {s.get('Issue_Body') or ''}")
+        labels.append(1 if str(s.get(target)) in ("1", "1.0", "pos") else 0)
+    return texts, np.asarray(labels)
+
+
+def default_learners(seed: int = 2021) -> Dict[str, object]:
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import MultinomialNB
+    from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.neural_network import MLPClassifier
+
+    return {
+        "RF": RandomForestClassifier(
+            n_estimators=30, oob_score=True, random_state=seed
+        ),
+        "NB": MultinomialNB(),
+        "MLP": MLPClassifier(max_iter=10, random_state=seed),
+        "LR": LogisticRegression(max_iter=1000, random_state=seed),
+        "KNN": KNeighborsClassifier(n_jobs=-1),
+    }
+
+
+def run_baselines(
+    train_path: Union[str, Path],
+    test_path: Union[str, Path],
+    out_dir: Union[str, Path],
+    target: str = "Security_Issue_Full",
+    learners: Optional[Dict[str, object]] = None,
+    feature_selection: bool = True,
+    seed: int = 2021,
+) -> Dict[str, Dict[str, float]]:
+    from sklearn.feature_extraction.text import CountVectorizer
+    from sklearn.feature_selection import SelectFromModel
+    from sklearn.svm import LinearSVC
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    train = json.loads(Path(train_path).read_text())
+    test = json.loads(Path(test_path).read_text())
+    train_texts, y_train = _texts_and_labels(train, target)
+    test_texts, y_test = _texts_and_labels(test, target)
+    test_ids = [s.get("Issue_Url") for s in test]
+
+    vectorizer = CountVectorizer(stop_words="english", min_df=1)
+    x_train = vectorizer.fit_transform(train_texts)
+    x_test = vectorizer.transform(test_texts)
+
+    if feature_selection and x_train.shape[1] > 1:
+        # L1 LinearSVC feature selection (reference: dimension_reduce.py:18-25)
+        svc = LinearSVC(penalty="l1", C=0.3, dual=False, random_state=seed)
+        selector = SelectFromModel(svc.fit(x_train, y_train), prefit=True)
+        if int(selector.get_support().sum()) > 0:
+            x_train = selector.transform(x_train)
+            x_test = selector.transform(x_test)
+    logger.info("feature matrix: %s", x_train.shape)
+
+    results: Dict[str, Dict[str, float]] = {}
+    for name, learner in (learners or default_learners(seed)).items():
+        learner.fit(x_train, y_train)
+        preds = learner.predict(x_test)
+        if hasattr(learner, "predict_proba"):
+            scores = learner.predict_proba(x_test)[:, 1]
+        elif hasattr(learner, "decision_function"):
+            scores = learner.decision_function(x_test)
+        else:
+            scores = preds.astype(float)
+        measured = model_measure(y_test, preds, scores)
+        results[name] = measured
+        records = [
+            {
+                "Issue_Url": test_ids[i],
+                "label": "pos" if y_test[i] else "neg",
+                "predict": "pos" if preds[i] else "neg",
+                "prob": float(scores[i]),
+            }
+            for i in range(len(y_test))
+        ]
+        (out_dir / f"{name}_result.json").write_text(json.dumps(records))
+        (out_dir / f"{name}_metric.json").write_text(json.dumps(measured, indent=4))
+        logger.info("%s: %s", name, measured)
+    return results

@@ -169,6 +169,77 @@ class WordPieceTokenizer(TextTokenizer):
         self._tok.save(str(path))
 
 
+@TextTokenizer.register("word")
+class WordTokenizer(TextTokenizer):
+    """Word-level tokenizer for the TextCNN baseline (the reference uses
+    SpaCy word tokens + a GloVe vocabulary, TextCNN/config_cnn.json:31-41).
+    Vocabulary is built from the corpus: index 0 = [PAD], 1 = [UNK]."""
+
+    def __init__(
+        self,
+        vocab: Optional[Dict[str, int]] = None,
+        vocab_path: Optional[Union[str, Path]] = None,
+        lowercase: bool = True,
+    ) -> None:
+        if vocab is None:
+            if vocab_path is None:
+                raise ValueError("need vocab or vocab_path")
+            vocab = json.loads(Path(vocab_path).read_text())
+        self._vocab = vocab
+        self._lowercase = lowercase
+
+    @classmethod
+    def train_from_corpus(
+        cls,
+        texts: Iterable[str],
+        max_vocab: int = 50_000,
+        min_count: int = 1,
+        lowercase: bool = True,
+        save_path: Optional[Union[str, Path]] = None,
+    ) -> "WordTokenizer":
+        from collections import Counter
+
+        counts: Counter = Counter()
+        for text in texts:
+            counts.update(cls._split(text, lowercase))
+        vocab = {PAD: 0, UNK: 1}
+        for word, c in counts.most_common(max_vocab - 2):
+            if c < min_count:
+                break
+            vocab[word] = len(vocab)
+        if save_path is not None:
+            Path(save_path).write_text(json.dumps(vocab))
+        return cls(vocab=vocab, lowercase=lowercase)
+
+    @staticmethod
+    def _split(text: str, lowercase: bool) -> List[str]:
+        import re
+
+        if lowercase:
+            text = text.lower()
+        return re.findall(r"[a-zA-Z]+|[0-9]+|[^\sa-zA-Z0-9]", text)
+
+    def encode(self, text: str, max_length: Optional[int] = None) -> List[int]:
+        unk = self._vocab[UNK]
+        ids = [self._vocab.get(w, unk) for w in self._split(text, self._lowercase)]
+        if max_length is not None:
+            ids = ids[:max_length]
+        return ids or [unk]
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._vocab)
+
+    @property
+    def pad_id(self) -> int:
+        return self._vocab[PAD]
+
+    @property
+    def vocab_words(self) -> List[str]:
+        ordered = sorted(self._vocab.items(), key=lambda kv: kv[1])
+        return [w for w, _ in ordered]
+
+
 def _apply_bert_pretokenization(tok, lowercase: bool) -> None:
     from tokenizers import normalizers, pre_tokenizers
 

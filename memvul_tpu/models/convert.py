@@ -1,0 +1,150 @@
+"""PyTorch → Flax weight conversion for the BERT encoder.
+
+The reference fine-tunes from HF PyTorch checkpoints (bert-base-uncased
+or the further-pretrained ``out_wwm/`` dir, custom_PTM_embedder.py:95-99).
+This module maps an HF ``BertModel`` state_dict onto the in-repo encoder's
+parameter tree so those checkpoints are usable for F1-parity runs — the
+single highest-risk item called out in SURVEY.md §7.
+
+Layout notes: torch ``nn.Linear`` stores [out, in] (transposed vs Flax);
+the attention projections reshape to per-head [in, H, Dh] for
+``nn.DenseGeneral``; with ``scan_layers`` the per-layer trees stack into
+leading-[L] arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .bert import BertConfig
+
+
+def _t(w: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(w.T)
+
+
+def _layer_params(sd: Dict[str, np.ndarray], i: int, c: BertConfig) -> Dict:
+    h, heads = c.hidden_size, c.num_heads
+    dh = h // heads
+    p = f"encoder.layer.{i}."
+
+    def qkv(name: str) -> Dict:
+        kernel = _t(sd[p + f"attention.self.{name}.weight"]).reshape(h, heads, dh)
+        bias = sd[p + f"attention.self.{name}.bias"].reshape(heads, dh)
+        return {"kernel": kernel, "bias": bias}
+
+    attn_out_kernel = _t(sd[p + "attention.output.dense.weight"]).reshape(
+        heads, dh, h
+    )
+    return {
+        "attention": {
+            "query": qkv("query"),
+            "key": qkv("key"),
+            "value": qkv("value"),
+            "output": {
+                "kernel": attn_out_kernel,
+                "bias": sd[p + "attention.output.dense.bias"],
+            },
+            "output_LayerNorm": {
+                "scale": sd[p + "attention.output.LayerNorm.weight"],
+                "bias": sd[p + "attention.output.LayerNorm.bias"],
+            },
+        },
+        "intermediate": {
+            "kernel": _t(sd[p + "intermediate.dense.weight"]),
+            "bias": sd[p + "intermediate.dense.bias"],
+        },
+        "output": {
+            "kernel": _t(sd[p + "output.dense.weight"]),
+            "bias": sd[p + "output.dense.bias"],
+        },
+        "output_LayerNorm": {
+            "scale": sd[p + "output.LayerNorm.weight"],
+            "bias": sd[p + "output.LayerNorm.bias"],
+        },
+    }
+
+
+def convert_bert_state_dict(
+    state_dict: Dict[str, np.ndarray], config: BertConfig
+) -> Tuple[Dict, Optional[Dict]]:
+    """HF BertModel state_dict → (encoder subtree for ``params/bert``,
+    pooler subtree for ``params/pooler`` or None).
+
+    Accepts keys with or without a leading ``bert.`` prefix; tensors may be
+    torch tensors or numpy arrays.
+    """
+    sd = {}
+    for k, v in state_dict.items():
+        if k.startswith("bert."):
+            k = k[len("bert."):]
+        sd[k] = np.asarray(
+            v.detach().cpu().numpy() if hasattr(v, "detach") else v
+        )
+
+    embeddings = {
+        "word_embeddings": {"embedding": sd["embeddings.word_embeddings.weight"]},
+        "position_embeddings": {
+            "embedding": sd["embeddings.position_embeddings.weight"]
+        },
+        "token_type_embeddings": {
+            "embedding": sd["embeddings.token_type_embeddings.weight"]
+        },
+        "LayerNorm": {
+            "scale": sd["embeddings.LayerNorm.weight"],
+            "bias": sd["embeddings.LayerNorm.bias"],
+        },
+    }
+    layers = [_layer_params(sd, i, config) for i in range(config.num_layers)]
+    if config.scan_layers:
+        import jax
+
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs, 0), *layers)
+        encoder = {"layers": {"layer": stacked}}
+    else:
+        encoder = {f"layer_{i}": layers[i] for i in range(config.num_layers)}
+
+    bert_subtree = {"embeddings": embeddings, "encoder": encoder}
+    pooler = None
+    if "pooler.dense.weight" in sd:
+        pooler = {
+            "dense": {
+                "kernel": _t(sd["pooler.dense.weight"]),
+                "bias": sd["pooler.dense.bias"],
+            }
+        }
+    return bert_subtree, pooler
+
+
+def load_into_classifier(classifier_params, state_dict, config: BertConfig):
+    """Return classifier params with the encoder (and pooler, if present)
+    replaced by converted torch weights."""
+    import copy
+
+    bert_subtree, pooler = convert_bert_state_dict(state_dict, config)
+    out = copy.deepcopy(
+        {"params": dict(classifier_params["params"])}
+    )
+    _check_shapes(out["params"]["bert"], bert_subtree, "bert")
+    out["params"]["bert"] = bert_subtree
+    if pooler is not None and "pooler" in out["params"]:
+        _check_shapes(out["params"]["pooler"], pooler, "pooler")
+        out["params"]["pooler"] = pooler
+    return out
+
+
+def _check_shapes(ours, theirs, name: str) -> None:
+    import jax
+
+    ours_leaves = jax.tree_util.tree_leaves_with_path(ours)
+    theirs_flat = dict(jax.tree_util.tree_leaves_with_path(theirs))
+    for path, leaf in ours_leaves:
+        if path not in theirs_flat:
+            raise KeyError(f"{name}: missing converted param at {path}")
+        if tuple(theirs_flat[path].shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"{name}: shape mismatch at {path}: "
+                f"{theirs_flat[path].shape} vs {np.shape(leaf)}"
+            )

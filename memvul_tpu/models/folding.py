@@ -82,12 +82,32 @@ def fold_tokens(
 
 
 def unfold_embeddings(
-    embeddings: np.ndarray, num_segments: int
-) -> np.ndarray:
-    """[B·S, L, D] per-segment embeddings → [B, S·(L-2), D] stitched stream
-    (per-segment CLS/SEP embeddings dropped), mirroring the reference's
-    unfold (custom_PTM_embedder.py:286-381)."""
+    embeddings: np.ndarray,
+    num_segments: int,
+    folded_mask: np.ndarray = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """[B·S, L, D] per-segment embeddings → ([B, S·(L-2), D] stitched
+    stream, [B, S·(L-2)] validity mask), mirroring the reference's unfold
+    (custom_PTM_embedder.py:286-381).
+
+    Positions 0 and L-1 of every segment (the re-inserted CLS and the
+    worst-case SEP slot) are dropped structurally; ``folded_mask`` (the
+    mask returned by :func:`fold_tokens`) additionally invalidates the SEP
+    of partially-filled segments and padding, which sit *inside* the
+    [1:-1] window.  Without it the validity mask only reflects the
+    structural trim."""
     bs, length, dim = embeddings.shape
     batch = bs // num_segments
-    inner = embeddings[:, 1:-1, :]  # drop CLS/SEP positions
-    return inner.reshape(batch, num_segments * (length - 2), dim)
+    inner = embeddings[:, 1:-1, :]
+    stream = inner.reshape(batch, num_segments * (length - 2), dim)
+    if folded_mask is not None:
+        valid = folded_mask.copy()
+        # invalidate each segment's trailing SEP (last masked position)
+        lengths = valid.sum(axis=1)
+        for i in range(bs):
+            if lengths[i] > 0:
+                valid[i, lengths[i] - 1] = 0
+        valid = valid[:, 1:-1].reshape(batch, num_segments * (length - 2))
+    else:
+        valid = np.ones((batch, num_segments * (length - 2)), dtype=np.int32)
+    return stream, valid

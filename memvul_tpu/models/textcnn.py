@@ -1,0 +1,99 @@
+"""TextCNN baseline classifier.
+
+Reference (TextCNN/model_cnn.py): SpaCy word tokens → 300-d trainable
+embedding (GloVe-initialized when vectors are available) → CNN encoder
+with 256 filters per ngram size 2-5 → FeedForward(→512, ReLU) →
+Linear(→2).  Inputs shorter than the largest ngram are padded up to it
+(reference: model_cnn.py:36-46,101).
+
+TPU note: the convolution bank is expressed as `nn.Conv` over the token
+axis; all four ngram branches run in one program and XLA fuses the
+max-pool reductions.  GloVe vectors are optional — zero-egress
+environments train the embedding from scratch (`glove_path=None`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from .losses import masked_cross_entropy  # noqa: F401  (re-exported for users)
+
+
+class TextCNN(nn.Module):
+    vocab_size: int
+    embed_dim: int = 300
+    num_filters: int = 256
+    ngram_sizes: Sequence[int] = (2, 3, 4, 5)
+    header_dim: int = 512
+    num_classes: int = 2
+    dropout: float = 0.1
+    pad_id: int = 0
+
+    @nn.compact
+    def __call__(self, sample1, deterministic: bool = True) -> jax.Array:
+        ids = sample1["input_ids"]
+        mask = sample1["attention_mask"]
+        min_len = max(self.ngram_sizes)
+        if ids.shape[-1] < min_len:
+            pad = min_len - ids.shape[-1]
+            ids = jnp.pad(ids, ((0, 0), (0, pad)), constant_values=self.pad_id)
+            mask = jnp.pad(mask, ((0, 0), (0, pad)))
+
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embedding")(ids)
+        # zero out padding embeddings so max-pool cannot pick them... except
+        # where a row is fully padded; a -inf floor keeps the pool defined
+        neg = jnp.finfo(x.dtype).min
+        x = jnp.where(mask[..., None] > 0, x, 0.0)
+
+        pooled = []
+        for n in self.ngram_sizes:
+            conv = nn.Conv(
+                self.num_filters, kernel_size=(n,), padding="VALID",
+                name=f"conv_{n}",
+            )(x)
+            conv = nn.relu(conv)
+            # mask windows that begin beyond the real tokens
+            starts = mask[:, : conv.shape[1]]
+            conv = jnp.where(starts[..., None] > 0, conv, neg)
+            pooled.append(conv.max(axis=1))
+        features = jnp.concatenate(pooled, axis=-1)
+        features = jnp.maximum(features, 0.0)  # all-padding rows → zeros
+        features = nn.Dropout(self.dropout)(features, deterministic=deterministic)
+        hidden = nn.relu(nn.Dense(self.header_dim, name="header")(features))
+        hidden = nn.Dropout(self.dropout)(hidden, deterministic=deterministic)
+        return nn.Dense(self.num_classes, use_bias=False, name="classifier")(hidden)
+
+    def load_pretrained_embedding(self, params, vectors: np.ndarray):
+        """Replace the embedding table (e.g. with GloVe vectors laid out by
+        the tokenizer's vocab order).  Returns updated params."""
+        if vectors.shape != params["params"]["embedding"]["embedding"].shape:
+            raise ValueError(
+                f"vector table {vectors.shape} != embedding "
+                f"{params['params']['embedding']['embedding'].shape}"
+            )
+        import flax
+
+        flat = flax.traverse_util.flatten_dict(params)
+        flat[("params", "embedding", "embedding")] = jnp.asarray(vectors)
+        return flax.traverse_util.unflatten_dict(flat)
+
+
+def load_glove_vectors(
+    path: str, vocab: Sequence[str], dim: int = 300, seed: int = 0
+) -> np.ndarray:
+    """Read a GloVe .txt file and assemble a [V, dim] table in vocab order;
+    missing words get small random vectors."""
+    rng = np.random.default_rng(seed)
+    table = rng.normal(scale=0.1, size=(len(vocab), dim)).astype(np.float32)
+    wanted = {w: i for i, w in enumerate(vocab)}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            if parts[0] in wanted and len(parts) == dim + 1:
+                table[wanted[parts[0]]] = np.asarray(parts[1:], dtype=np.float32)
+    return table

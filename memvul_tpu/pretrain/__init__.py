@@ -1,0 +1,7 @@
+from .mlm import (  # noqa: F401
+    MLMModel,
+    MLMTrainer,
+    extract_encoder_params,
+    transplant_encoder,
+    whole_word_mask,
+)

@@ -1,0 +1,261 @@
+"""Whole-word-mask MLM further pretraining.
+
+The reference runs HF's ``run_mlm_wwm.py`` over one-report-per-line text
+(50 epochs, mask prob 0.15, ``DataCollatorForWholeWordMask`` —
+further_pretrain.json, run_mlm_wwm.py:349-359) and the resulting
+checkpoint is loaded by the classifier's embedder
+(custom_PTM_embedder.py:95-99).
+
+Here the same subsystem is native: a whole-word-mask collator over
+wordpiece ids (a "word" = a token plus its ``##`` continuations), an MLM
+head over the in-repo Flax BERT with the decoder tied to the input
+embedding table, and a compact jitted training loop.  The pretrained
+encoder subtree transplants directly into MemoryModel/SingleModel params
+(:func:`transplant_encoder`) — the further-pretrain → fine-tune contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from ..models.bert import BertConfig, BertEncoder, _dense_init
+
+logger = logging.getLogger(__name__)
+
+IGNORE = -100
+
+
+# -- masking -----------------------------------------------------------------
+
+
+def continuation_flags(tokenizer) -> np.ndarray:
+    """[V] bool: True for ``##`` continuation wordpieces."""
+    flags = np.zeros(tokenizer.vocab_size, dtype=bool)
+    vocab = tokenizer._tok.get_vocab()
+    for token, idx in vocab.items():
+        if token.startswith("##"):
+            flags[idx] = True
+    return flags
+
+
+def whole_word_mask(
+    ids: np.ndarray,
+    attention_mask: np.ndarray,
+    rng: np.random.Generator,
+    mask_id: int,
+    vocab_size: int,
+    continuation: np.ndarray,
+    special_ids: Iterable[int],
+    mask_prob: float = 0.15,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """HF DataCollatorForWholeWordMask semantics over a [B, L] batch:
+    pick ~15% of *words* (a head wordpiece plus its continuations); of the
+    chosen tokens 80% → [MASK], 10% → random id, 10% → unchanged.
+    Returns (masked_ids, labels) with labels = IGNORE off the masked set."""
+    special = set(int(s) for s in special_ids)
+    masked = ids.copy()
+    labels = np.full_like(ids, IGNORE)
+    B, L = ids.shape
+    for b in range(B):
+        # word start indices
+        words: List[List[int]] = []
+        for i in range(L):
+            if not attention_mask[b, i] or int(ids[b, i]) in special:
+                continue
+            if continuation[ids[b, i]] and words:
+                words[-1].append(i)
+            else:
+                words.append([i])
+        if not words:
+            continue
+        n_mask = max(1, int(round(len(words) * mask_prob)))
+        chosen = rng.permutation(len(words))[:n_mask]
+        for w in chosen:
+            for i in words[w]:
+                labels[b, i] = ids[b, i]
+                roll = rng.random()
+                if roll < 0.8:
+                    masked[b, i] = mask_id
+                elif roll < 0.9:
+                    masked[b, i] = rng.integers(0, vocab_size)
+    return masked, labels
+
+
+# -- model -------------------------------------------------------------------
+
+
+class MLMModel(nn.Module):
+    """BERT encoder + transform head + decoder tied to the word-embedding
+    table (HF BertForMaskedLM layout)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, deterministic: bool = True):
+        c = self.config
+        encoder = BertEncoder(c, name="bert")
+        hidden = encoder(input_ids, attention_mask, deterministic=deterministic)
+        x = nn.Dense(c.hidden_size, kernel_init=_dense_init(c), dtype=c.dtype,
+                     name="transform")(hidden)
+        x = nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, dtype=c.dtype,
+                         name="transform_LayerNorm")(x)
+        embed_table = encoder.variables["params"]["embeddings"][
+            "word_embeddings"
+        ]["embedding"]
+        logits = x @ embed_table.T.astype(x.dtype)
+        bias = self.param("decoder_bias", nn.initializers.zeros, (c.vocab_size,))
+        return logits + bias.astype(logits.dtype)
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over positions with labels != IGNORE."""
+    mask = (labels != IGNORE).astype(jnp.float32)
+    safe_labels = jnp.where(labels == IGNORE, 0, labels)
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(log_probs, safe_labels[..., None], axis=-1)[..., 0]
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+# -- params plumbing ---------------------------------------------------------
+
+
+def extract_encoder_params(mlm_params) -> Dict:
+    """The ``bert`` subtree of an MLM checkpoint."""
+    return jax.device_get(mlm_params)["params"]["bert"]
+
+
+def transplant_encoder(classifier_params, encoder_subtree) -> Dict:
+    """Insert a pretrained encoder into MemoryModel/SingleModel params
+    (their encoder also lives under ``params/bert``) — the counterpart of
+    the reference's pretrained_model_path loading
+    (custom_PTM_embedder.py:95-99)."""
+    out = jax.device_get(classifier_params)
+    out = jax.tree_util.tree_map(lambda x: x, out)  # shallow copy tree
+    import copy
+
+    out = copy.deepcopy(out)
+    out["params"]["bert"] = copy.deepcopy(encoder_subtree)
+    return out
+
+
+# -- trainer -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MLMTrainerConfig:
+    batch_size: int = 16
+    grad_accum: int = 2
+    max_length: int = 256
+    mask_prob: float = 0.15
+    learning_rate: float = 5e-5
+    warmup_steps: int = 50000
+    num_epochs: int = 50
+    seed: int = 2021
+    steps_per_epoch: Optional[int] = None
+
+
+class MLMTrainer:
+    def __init__(
+        self,
+        config: BertConfig,
+        tokenizer,
+        trainer_config: Optional[MLMTrainerConfig] = None,
+    ) -> None:
+        import optax
+
+        self.model = MLMModel(config)
+        self.tokenizer = tokenizer
+        self.c = trainer_config or MLMTrainerConfig()
+        self._continuation = continuation_flags(tokenizer)
+        self._special = [tokenizer.pad_id, tokenizer.cls_id, tokenizer.sep_id]
+        self._np_rng = np.random.default_rng(self.c.seed)
+
+        dummy = np.zeros((2, 8), np.int32)
+        self.params = self.model.init(
+            jax.random.PRNGKey(self.c.seed), dummy, np.ones_like(dummy)
+        )
+        from ..training.optim import linear_with_warmup
+
+        schedule = linear_with_warmup(self.c.warmup_steps)
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.scale_by_adam(),
+            optax.scale_by_schedule(schedule),
+            optax.scale(-self.c.learning_rate),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self.step = 0
+
+        def train_step(params, opt_state, ids, mask, labels, rng):
+            def loss_fn(p):
+                logits = self.model.apply(
+                    p, ids, mask, deterministic=False, rngs={"dropout": rng}
+                )
+                return mlm_loss(logits, labels)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates
+            )
+            return params, opt_state, loss
+
+        self._train_step = jax.jit(train_step)
+
+    def _batches(self, lines: List[str]) -> Iterator[Tuple[np.ndarray, ...]]:
+        c = self.c
+        order = self._np_rng.permutation(len(lines))
+        for start in range(0, len(lines) - c.batch_size + 1, c.batch_size):
+            texts = [lines[i] for i in order[start : start + c.batch_size]]
+            ids = np.full((c.batch_size, c.max_length), self.tokenizer.pad_id, np.int32)
+            mask = np.zeros_like(ids)
+            for i, t in enumerate(texts):
+                seq = self.tokenizer.encode(t, max_length=c.max_length)
+                ids[i, : len(seq)] = seq
+                mask[i, : len(seq)] = 1
+            masked, labels = whole_word_mask(
+                ids, mask, self._np_rng, self.tokenizer.mask_id,
+                self.tokenizer.vocab_size, self._continuation, self._special,
+                c.mask_prob,
+            )
+            yield masked, mask, labels
+
+    def train(self, corpus_path: str) -> Dict[str, float]:
+        c = self.c
+        lines = [
+            l.strip() for l in open(corpus_path, encoding="utf-8") if l.strip()
+        ]
+        logger.info("MLM corpus: %d lines", len(lines))
+        rng = jax.random.PRNGKey(c.seed)
+        history: List[float] = []
+        for epoch in range(c.num_epochs):
+            losses = []
+            started = time.perf_counter()
+            for i, (ids, mask, labels) in enumerate(self._batches(lines)):
+                if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
+                    break
+                rng, sub = jax.random.split(rng)
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params, self.opt_state, ids, mask, labels, sub
+                )
+                losses.append(float(loss))
+                self.step += 1
+            mean_loss = float(np.mean(losses)) if losses else 0.0
+            history.append(mean_loss)
+            logger.info(
+                "mlm epoch %d: loss %.4f (%.1fs)",
+                epoch, mean_loss, time.perf_counter() - started,
+            )
+        return {"final_loss": history[-1] if history else 0.0, "history": history}
+
+    def encoder_params(self):
+        return extract_encoder_params(self.params)

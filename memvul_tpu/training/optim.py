@@ -81,7 +81,11 @@ def make_optimizer(
         group_rules = (("bert/", "embedder"), ("pooler/", "pooler"))
     if group_lrs is None:
         group_lrs = {"embedder": 2e-5, "pooler": 5e-5}
-    schedule = linear_with_warmup(warmup_steps, total_steps) if warmup_steps else None
+    schedule = (
+        linear_with_warmup(warmup_steps, total_steps)
+        if (warmup_steps or total_steps is not None)
+        else None
+    )
 
     def adamw(lr: float) -> optax.GradientTransformation:
         chain = [optax.scale_by_adam(b1=betas[0], b2=betas[1])]

@@ -142,6 +142,7 @@ class MemoryTrainer:
             # the reference wires total steps as epochs × steps-per-epoch so
             # the warmup schedule decays to 0 (custom_trainer.py:949)
             total_steps = c.num_epochs * c.steps_per_epoch
+        self.total_steps = total_steps
         self.tx, opt_state = make_optimizer(
             params,
             group_lrs=c.group_lrs,
@@ -337,6 +338,16 @@ class MemoryTrainer:
         self.epoch = int(meta["epoch"]) + 1  # resume after the saved epoch
         tracker_state = dict(meta["tracker"])
         self.tracker.load_state_dict(tracker_state)
+        # reload per-epoch metrics history from the JSON sidecars so
+        # result["history"] covers pre-restore epochs too
+        if self.checkpointer is not None:
+            import json as _json
+
+            self.metrics_history = []
+            for i in range(self.epoch):
+                f = self.checkpointer.directory / f"metrics_epoch_{i}.json"
+                if f.exists():
+                    self.metrics_history.append(_json.loads(f.read_text()))
         if self.mesh is not None:
             self.params = replicate(self.params, self.mesh)
             self.opt_state = replicate(self.opt_state, self.mesh)

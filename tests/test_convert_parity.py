@@ -1,0 +1,117 @@
+"""Logit-level parity: HF PyTorch BertModel vs the in-repo Flax encoder
+through the weight converter (SURVEY §7 'hard parts' — the F1-parity
+oracle)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu.models import BertConfig, BertEncoder, BertPooler, MemoryModel
+from memvul_tpu.models.convert import convert_bert_state_dict, load_into_classifier
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_bert():
+    hf_cfg = transformers.BertConfig(
+        vocab_size=512,
+        hidden_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=128,
+        max_position_embeddings=128,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.BertModel(hf_cfg).eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def inputs():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(5, 500, size=(3, 24)).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[:, 20:] = 0
+    return ids, mask
+
+
+CFG = BertConfig(
+    vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+    intermediate_size=128, max_position_embeddings=128,
+)
+
+
+def torch_forward(hf_bert, ids, mask):
+    with torch.no_grad():
+        out = hf_bert(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        )
+    return out.last_hidden_state.numpy(), out.pooler_output.numpy()
+
+
+def test_encoder_logit_parity(hf_bert, inputs):
+    ids, mask = inputs
+    hf_hidden, _ = torch_forward(hf_bert, ids, mask)
+
+    bert_subtree, _ = convert_bert_state_dict(hf_bert.state_dict(), CFG)
+    enc = BertEncoder(CFG)
+    ours = enc.apply({"params": bert_subtree}, ids, mask)
+    ours = np.asarray(ours)
+    # compare only unmasked positions (masked positions are junk both ways)
+    real = mask.astype(bool)
+    np.testing.assert_allclose(ours[real], hf_hidden[real], rtol=2e-4, atol=2e-5)
+
+
+def test_scan_layers_parity(hf_bert, inputs):
+    ids, mask = inputs
+    hf_hidden, _ = torch_forward(hf_bert, ids, mask)
+    cfg = CFG.replace(scan_layers=True)
+    bert_subtree, _ = convert_bert_state_dict(hf_bert.state_dict(), cfg)
+    ours = np.asarray(BertEncoder(cfg).apply({"params": bert_subtree}, ids, mask))
+    real = mask.astype(bool)
+    np.testing.assert_allclose(ours[real], hf_hidden[real], rtol=2e-4, atol=2e-5)
+
+
+def test_pooler_parity(hf_bert, inputs):
+    ids, mask = inputs
+    _, hf_pooled = torch_forward(hf_bert, ids, mask)
+    bert_subtree, pooler = convert_bert_state_dict(hf_bert.state_dict(), CFG)
+    enc_out = BertEncoder(CFG).apply({"params": bert_subtree}, ids, mask)
+    ours = np.asarray(BertPooler(CFG).apply({"params": pooler}, enc_out))
+    np.testing.assert_allclose(ours, hf_pooled, rtol=2e-4, atol=2e-5)
+
+
+def test_load_into_classifier_replaces_encoder(hf_bert):
+    model = MemoryModel(CFG)
+    d = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), d, d)
+    loaded = load_into_classifier(params, hf_bert.state_dict(), CFG)
+    word = loaded["params"]["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    hf_word = hf_bert.state_dict()["embeddings.word_embeddings.weight"].numpy()
+    np.testing.assert_array_equal(np.asarray(word), hf_word)
+    # non-encoder params untouched
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["pair_kernel"]),
+        np.asarray(params["params"]["pair_kernel"]),
+    )
+
+
+def test_converter_shape_mismatch_raises(hf_bert):
+    small_cfg = CFG.replace(hidden_size=32, num_heads=2, intermediate_size=64)
+    model = MemoryModel(small_cfg)
+    d = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), d, d)
+    with pytest.raises((ValueError, KeyError)):
+        load_into_classifier(params, hf_bert.state_dict(), small_cfg)

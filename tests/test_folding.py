@@ -61,9 +61,23 @@ def test_batch_folding_shapes():
 def test_unfold_embeddings_roundtrip_shape():
     bs, length, dim = 6, 10, 4
     emb = np.arange(bs * length * dim, dtype=np.float32).reshape(bs, length, dim)
-    out = unfold_embeddings(emb, num_segments=3)
+    out, valid = unfold_embeddings(emb, num_segments=3)
     assert out.shape == (2, 3 * (length - 2), dim)
+    assert valid.shape == (2, 3 * (length - 2))
     # the first stitched row of report 0 is segment 0 position 1
     np.testing.assert_array_equal(out[0, 0], emb[0, 1])
     # the first row of the second segment follows the last of the first
     np.testing.assert_array_equal(out[0, length - 2], emb[1, 1])
+
+
+def test_unfold_mask_excludes_partial_segment_sep_and_padding():
+    # one report, two segments; second segment holds 2 tokens + SEP
+    ids, mask = frame(list(range(10, 20)), 16)  # 10 content tokens
+    folded, fmask, s = fold_tokens(
+        ids[None], mask[None], max_length=10, cls_id=CLS, sep_id=SEP, pad_id=PAD
+    )
+    assert s == 2
+    emb = np.zeros((folded.shape[0], folded.shape[1], 3), np.float32)
+    stream, valid = unfold_embeddings(emb, s, folded_mask=fmask)
+    # exactly the 10 content tokens are valid — no SEP, no padding
+    assert int(valid.sum()) == 10

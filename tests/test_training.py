@@ -218,11 +218,21 @@ def test_total_steps_decay_wired_from_steps_per_epoch(ws, tmp_path):
         ws, tmp_path, num_epochs=2, steps_per_epoch=3, warmup_steps=1,
         serialization_dir=None,
     )
-    # schedule decays to 0 at total_steps = 6
-    from memvul_tpu.training import linear_with_warmup
+    # the trainer wires total_steps = num_epochs * steps_per_epoch
+    assert trainer.total_steps == 6
+    explicit = make_trainer(
+        ws, tmp_path, num_epochs=2, steps_per_epoch=3, total_steps=11,
+        serialization_dir=None,
+    )
+    assert explicit.total_steps == 11
 
-    s = linear_with_warmup(1, total_steps=6)
-    assert float(s(6)) == 0.0
+
+def test_resume_restores_metrics_history(ws, tmp_path):
+    t1 = make_trainer(ws, tmp_path, num_epochs=2, steps_per_epoch=2)
+    r1 = t1.train()
+    t2 = make_trainer(ws, tmp_path, num_epochs=2, steps_per_epoch=2)
+    assert t2.maybe_restore()
+    assert len(t2.metrics_history) == len(r1["history"])
 
 
 def test_fold_tokens_does_not_mutate_inputs():

@@ -1,0 +1,173 @@
+"""TextCNN, MLM pretraining, sklearn baselines."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from memvul_tpu.data.synthetic import build_workspace, corpus_texts, generate_corpus
+from memvul_tpu.data.tokenizer import WordTokenizer
+from memvul_tpu.models import BertConfig
+from memvul_tpu.models.textcnn import TextCNN
+from memvul_tpu.pretrain import (
+    MLMModel,
+    MLMTrainer,
+    transplant_encoder,
+    whole_word_mask,
+)
+from memvul_tpu.pretrain.mlm import IGNORE, MLMTrainerConfig, continuation_flags, mlm_loss
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("wider"), seed=1)
+
+
+# -- word tokenizer / TextCNN -------------------------------------------------
+
+
+def test_word_tokenizer_roundtrip():
+    reports, _ = generate_corpus(seed=0)
+    tok = WordTokenizer.train_from_corpus(corpus_texts(reports), max_vocab=500)
+    ids = tok.encode("the build fails on windows")
+    assert all(isinstance(i, int) for i in ids)
+    assert tok.encode("") == [1]  # UNK fallback, never empty
+    assert tok.pad_id == 0
+
+
+def test_word_tokenizer_unknown_words():
+    tok = WordTokenizer(vocab={"[PAD]": 0, "[UNK]": 1, "build": 2})
+    assert tok.encode("build zzzqqq") == [2, 1]
+
+
+def test_textcnn_forward_shapes():
+    model = TextCNN(vocab_size=100, embed_dim=16, num_filters=8)
+    ids = np.array([[5, 6, 7, 8, 9, 10, 0, 0]], np.int32)
+    batch = {"input_ids": ids, "attention_mask": (ids != 0).astype(np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch)
+    logits = model.apply(params, batch)
+    assert logits.shape == (1, 2)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_textcnn_short_input_padded_to_ngram():
+    model = TextCNN(vocab_size=50, embed_dim=8, num_filters=4)
+    ids = np.array([[7, 8]], np.int32)  # shorter than largest ngram (5)
+    batch = {"input_ids": ids, "attention_mask": np.ones_like(ids)}
+    params = model.init(jax.random.PRNGKey(0), batch)
+    logits = model.apply(params, batch)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_textcnn_embedding_override():
+    model = TextCNN(vocab_size=10, embed_dim=4, num_filters=2)
+    ids = np.array([[1, 2, 3, 4, 5]], np.int32)
+    batch = {"input_ids": ids, "attention_mask": np.ones_like(ids)}
+    params = model.init(jax.random.PRNGKey(0), batch)
+    table = np.arange(40, dtype=np.float32).reshape(10, 4)
+    updated = model.load_pretrained_embedding(params, table)
+    np.testing.assert_array_equal(
+        np.asarray(updated["params"]["embedding"]["embedding"]), table
+    )
+
+
+# -- whole word mask / MLM ----------------------------------------------------
+
+
+def test_whole_word_mask_masks_continuations(ws):
+    tok = ws["tokenizer"]
+    flags = continuation_flags(tok)
+    assert flags.sum() > 0  # vocabulary has ## pieces
+    text = "authentication vulnerability in parser"
+    ids = np.asarray([tok.encode(text)], np.int32)
+    mask = np.ones_like(ids)
+    rng = np.random.default_rng(0)
+    masked, labels = whole_word_mask(
+        ids, mask, rng, tok.mask_id, tok.vocab_size, flags,
+        [tok.pad_id, tok.cls_id, tok.sep_id], mask_prob=0.5,
+    )
+    chosen = labels[0] != IGNORE
+    assert chosen.any()
+    # specials never chosen
+    assert labels[0][0] == IGNORE and labels[0][-1] == IGNORE
+    # a chosen head's continuations are chosen with it
+    for i in range(1, ids.shape[1] - 1):
+        if chosen[i] and i + 1 < ids.shape[1] - 1 and flags[ids[0, i + 1]]:
+            assert chosen[i + 1]
+
+
+def test_mlm_loss_only_on_masked_positions():
+    logits = jnp.zeros((1, 4, 10))
+    labels = jnp.asarray([[IGNORE, 3, IGNORE, 5]])
+    loss = mlm_loss(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(10), rtol=1e-6)
+
+
+def test_mlm_decoder_tied_to_embeddings(ws):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MLMModel(cfg)
+    ids = np.zeros((2, 8), np.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, np.ones_like(ids))
+    names = set(params["params"].keys())
+    assert "decoder_bias" in names
+    # no separate [V, D] decoder kernel — logits come from the embedding table
+    assert "decoder" not in names
+
+
+def test_mlm_training_reduces_loss_and_transplants(ws, tmp_path):
+    corpus = tmp_path / "mlm.txt"
+    reports, _ = generate_corpus(seed=2)
+    corpus.write_text("\n".join(corpus_texts(reports)))
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    trainer = MLMTrainer(
+        cfg,
+        ws["tokenizer"],
+        MLMTrainerConfig(
+            batch_size=8, max_length=32, num_epochs=3, steps_per_epoch=8,
+            learning_rate=3e-3, warmup_steps=2,
+        ),
+    )
+    out = trainer.train(str(corpus))
+    assert out["history"][-1] < out["history"][0]
+
+    # encoder subtree transplants into the classifier
+    from memvul_tpu.models import MemoryModel
+
+    clf = MemoryModel(cfg)
+    d = {"input_ids": np.zeros((2, 8), np.int32),
+         "attention_mask": np.ones((2, 8), np.int32)}
+    clf_params = clf.init(jax.random.PRNGKey(0), d, d)
+    loaded = transplant_encoder(clf_params, trainer.encoder_params())
+    trained_word = trainer.encoder_params()["embeddings"]["word_embeddings"]["embedding"]
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["bert"]["embeddings"]["word_embeddings"]["embedding"]),
+        np.asarray(trained_word),
+    )
+    # transplanted params run
+    logits = clf.apply(loaded, d, d)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+# -- sklearn baselines --------------------------------------------------------
+
+
+def test_sklearn_baselines_end_to_end(ws, tmp_path):
+    from memvul_tpu.baselines import run_baselines
+
+    results = run_baselines(
+        ws["paths"]["train"], ws["paths"]["test"], tmp_path / "baseline_out",
+        learners=None, seed=7,
+    )
+    assert set(results) == {"RF", "NB", "MLP", "LR", "KNN"}
+    for name, m in results.items():
+        assert {"TP", "FN", "TN", "FP", "f1", "auc", "ap"} <= set(m)
+        assert (tmp_path / "baseline_out" / f"{name}_result.json").exists()
+        assert (tmp_path / "baseline_out" / f"{name}_metric.json").exists()
+    records = json.loads(
+        (tmp_path / "baseline_out" / "RF_result.json").read_text()
+    )
+    test_corpus = json.loads(open(ws["paths"]["test"]).read())
+    assert len(records) == len(test_corpus)
