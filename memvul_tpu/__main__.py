@@ -1,0 +1,224 @@
+"""Command-line interface — the ``allennlp train`` / eval-script parity.
+
+The reference runs ``allennlp train <config> -s <dir> --include-package
+MemVul`` plus hand-edited ``predict_*.py``/``utils.py``/``baseline.py``
+scripts (reference: README.md:130-147).  Here everything is one CLI:
+
+    python -m memvul_tpu train configs/config_memory.json -s out/
+    python -m memvul_tpu evaluate out/model.tar.gz data/test_project.json -o eval/
+    python -m memvul_tpu pretrain configs/further_pretrain.json
+    python -m memvul_tpu baseline data/train_project.json data/test_project.json -o baseline_out/
+    python -m memvul_tpu build-data --csv all_samples.csv --out data/
+    python -m memvul_tpu bench
+
+``--mesh data=8`` shards any train/evaluate run over a device mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+
+
+def _honor_platform_env() -> None:
+    """A sitecustomize hook may pin jax to the TPU plugin before env vars
+    are consulted; re-assert an explicit ``JAX_PLATFORMS`` request so CPU
+    runs (e.g. virtual 8-device meshes) work from the CLI."""
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        import jax
+
+        jax.config.update("jax_platforms", requested)
+
+
+def _parse_mesh(spec):
+    """``"data=8"`` or ``"data=4,model=2"`` → mesh, None otherwise."""
+    if not spec:
+        return None
+    from .parallel import create_mesh
+
+    axes = {}
+    for part in spec.split(","):
+        name, size = part.split("=")
+        axes[name.strip()] = int(size)
+    return create_mesh(axes)
+
+
+def cmd_train(args) -> int:
+    from .build import train_from_config
+    from .config import load_config
+
+    config = load_config(args.config, overrides=args.overrides)
+    result = train_from_config(
+        config, args.serialization_dir, mesh=_parse_mesh(args.mesh)
+    )
+    print(json.dumps({
+        "best_epoch": result.get("best_epoch"),
+        "best_validation": result.get("best_validation"),
+        "archive": result.get("archive"),
+    }, default=float))
+    return 0
+
+
+def cmd_evaluate(args) -> int:
+    from .build import evaluate_from_archive
+
+    metrics = evaluate_from_archive(
+        args.archive,
+        args.test_path,
+        args.out_dir,
+        overrides=args.overrides,
+        golden_file=args.golden_file,
+        name=args.name,
+        mesh=_parse_mesh(args.mesh),
+        use_mesh=not args.no_mesh,
+        thres=args.threshold,
+    )
+    print(json.dumps(metrics, default=float))
+    return 0
+
+
+def cmd_pretrain(args) -> int:
+    from .build import build_tokenizer, encoder_config, save_encoder_checkpoint
+    from .config import load_config
+    from .pretrain.mlm import MLMTrainer, MLMTrainerConfig
+
+    config = load_config(args.config, overrides=args.overrides)
+    tokenizer = build_tokenizer(config.get("tokenizer"))
+    bert_cfg = encoder_config(config.get("encoder"), tokenizer.vocab_size)
+    trainer = MLMTrainer(
+        bert_cfg, tokenizer, MLMTrainerConfig(**(config.get("trainer") or {}))
+    )
+    result = trainer.train(config["train_data_path"])
+    out_dir = Path(config.get("output_dir", "further_pretrain/out_wwm"))
+    path = save_encoder_checkpoint(trainer.encoder_params(), out_dir)
+    print(json.dumps({"final_loss": result["final_loss"], "checkpoint": str(path)}))
+    return 0
+
+
+def cmd_baseline(args) -> int:
+    from .baselines.sklearn_baseline import run_baselines
+
+    metrics = run_baselines(
+        args.train_path, args.test_path, args.out_dir,
+        feature_selection=not args.no_feature_selection,
+    )
+    print(json.dumps(metrics, default=float))
+    return 0
+
+
+def cmd_build_data(args) -> int:
+    """Offline pipeline: CSV corpus → cleaned project splits + CWE anchors
+    + MLM corpus (reference: utils.py:66-152,238-350,30-37)."""
+    import csv as _csv
+
+    from .data.corpus import preprocess, split_by_project, write_json, write_mlm_corpus
+    from .data.cwe import (
+        build_anchors, build_cwe_tree, cwe_distribution,
+        load_research_view_csv, save_anchors,
+    )
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    with open(args.csv, newline="", encoding="utf-8") as f:
+        reports = list(_csv.DictReader(f))
+    cve_dict = json.loads(Path(args.cve_dict).read_text()) if args.cve_dict else {}
+
+    clean = preprocess(reports)
+    train, test = split_by_project(clean, held_out_frac=0.1, seed=args.seed)
+    train, validation = split_by_project(train, held_out_frac=0.1, seed=args.seed + 1)
+    write_json(train, out / "train_project.json")
+    write_json(validation, out / "validation_project.json")
+    write_json(test, out / "test_project.json")
+    n_lines = write_mlm_corpus(clean, out / "train_project_mlm.txt")
+
+    n_anchors = 0
+    if args.cwe_csv and cve_dict:
+        tree = build_cwe_tree(load_research_view_csv(args.cwe_csv))
+        positives = [
+            r for r in train if str(r.get("Security_Issue_Full")) in ("1", "1.0")
+        ]
+        for r in positives:
+            cve = cve_dict.get(r.get("CVE_ID"))
+            if cve:
+                r.setdefault("CWE_ID", cve.get("CWE_ID"))
+        dist = cwe_distribution(positives, cve_dict)
+        anchors = build_anchors(dist, tree, cve_dict, seed=args.seed)
+        save_anchors(anchors, out / "CWE_anchor_golden_project.json")
+        n_anchors = len(anchors)
+    print(json.dumps({
+        "train": len(train), "validation": len(validation), "test": len(test),
+        "mlm_lines": n_lines, "anchors": n_anchors,
+    }))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import runpy
+
+    runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"),
+                   run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(levelname)s %(name)s: %(message)s")
+    parser = argparse.ArgumentParser(prog="memvul_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("train", help="train a model from a JSON config")
+    p.add_argument("config")
+    p.add_argument("-s", "--serialization-dir", required=True)
+    p.add_argument("-o", "--overrides", default=None,
+                   help="JSON string deep-merged onto the config")
+    p.add_argument("--mesh", default=None, help='e.g. "data=8"')
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("evaluate", help="evaluate an archived model")
+    p.add_argument("archive", help="model.tar.gz or its serialization dir")
+    p.add_argument("test_path")
+    p.add_argument("-o", "--out-dir", required=True)
+    p.add_argument("--overrides", default=None)
+    p.add_argument("--golden-file", default=None,
+                   help="anchor file (memory model; defaults to the config's)")
+    p.add_argument("--name", default=None, help="output file prefix")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--mesh", default=None)
+    p.add_argument("--no-mesh", action="store_true")
+    p.set_defaults(fn=cmd_evaluate)
+
+    p = sub.add_parser("pretrain", help="MLM further-pretraining")
+    p.add_argument("config")
+    p.add_argument("-o", "--overrides", default=None)
+    p.set_defaults(fn=cmd_pretrain)
+
+    p = sub.add_parser("baseline", help="sklearn baselines")
+    p.add_argument("train_path")
+    p.add_argument("test_path")
+    p.add_argument("-o", "--out-dir", required=True)
+    p.add_argument("--no-feature-selection", action="store_true")
+    p.set_defaults(fn=cmd_baseline)
+
+    p = sub.add_parser("build-data", help="offline corpus pipeline")
+    p.add_argument("--csv", required=True, help="all_samples.csv")
+    p.add_argument("--cve-dict", default=None, help="CVE_dict.json")
+    p.add_argument("--cwe-csv", default=None, help="CWE Research View 1000.csv")
+    p.add_argument("--out", required=True)
+    p.add_argument("--seed", type=int, default=2021)
+    p.set_defaults(fn=cmd_build_data)
+
+    p = sub.add_parser("bench", help="run the throughput benchmark")
+    p.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    _honor_platform_env()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

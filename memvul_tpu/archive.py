@@ -1,0 +1,97 @@
+"""Model archives — the reference's ``model.tar.gz`` contract.
+
+The reference trains with ``allennlp train``, which leaves a
+``model.tar.gz`` (config + weights + vocabulary) in the serialization
+dir; evaluation loads it back with partial config overrides
+(reference: predict_memory.py:60-67).  This module keeps that contract:
+an archive is a tar.gz holding
+
+* ``config.json``     — the fully-resolved training config,
+* ``weights.msgpack`` — flax-serialized parameters,
+* ``tokenizer.json``  — the tokenizer state (when file-backed).
+
+``load_archive(path, overrides)`` deep-merges overrides onto the stored
+config (the reference's with_fallback semantics) and reconstructs the
+model + params + tokenizer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import tarfile
+import tempfile
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+from flax import serialization
+
+from .config import merge_overrides
+
+ARCHIVE_NAME = "model.tar.gz"
+
+
+@dataclasses.dataclass
+class Archive:
+    config: Dict[str, Any]
+    model: Any
+    params: Any
+    tokenizer: Any
+
+
+def save_archive(
+    out_path: Union[str, Path],
+    config: Dict[str, Any],
+    params,
+    tokenizer_file: Optional[Union[str, Path]] = None,
+) -> Path:
+    """Package config + params (+ tokenizer file) into ``out_path``."""
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        (tmp / "config.json").write_text(json.dumps(config, indent=2))
+        (tmp / "weights.msgpack").write_bytes(serialization.to_bytes(params))
+        members = ["config.json", "weights.msgpack"]
+        if tokenizer_file is not None and Path(tokenizer_file).exists():
+            (tmp / "tokenizer.json").write_text(Path(tokenizer_file).read_text())
+            members.append("tokenizer.json")
+        with tarfile.open(out_path, "w:gz") as tar:
+            for name in members:
+                tar.add(tmp / name, arcname=name)
+    return out_path
+
+
+def load_archive(
+    archive_path: Union[str, Path],
+    overrides: Optional[Union[str, Dict[str, Any]]] = None,
+) -> Archive:
+    """Load an archive (or a serialization dir containing one), merging
+    config ``overrides`` (reference: predict_memory.py:60-67)."""
+    from .build import build_model, build_tokenizer  # lazy: avoids cycle
+
+    archive_path = Path(archive_path)
+    if archive_path.is_dir():
+        archive_path = archive_path / ARCHIVE_NAME
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        with tarfile.open(archive_path, "r:gz") as tar:
+            tar.extractall(tmp, filter="data")
+        config = json.loads((tmp / "config.json").read_text())
+        if overrides:
+            if isinstance(overrides, str):
+                overrides = json.loads(overrides)
+            config = merge_overrides(config, overrides)
+        tok_file = tmp / "tokenizer.json"
+        tok_cfg = dict(config.get("tokenizer") or {})
+        if tok_file.exists():
+            # word-level tokenizers store a plain vocab dict, wordpiece a
+            # full tokenizers-library file — different constructor params
+            key = "vocab_path" if tok_cfg.get("type") == "word" else "tokenizer_path"
+            tok_cfg[key] = str(tok_file)
+        tokenizer = build_tokenizer(tok_cfg)
+        model = build_model(config.get("model") or {}, tokenizer.vocab_size)
+        params = serialization.msgpack_restore(
+            (tmp / "weights.msgpack").read_bytes()
+        )
+    return Archive(config=config, model=model, params=params, tokenizer=tokenizer)

@@ -1,0 +1,284 @@
+"""Config-driven construction — the ``allennlp train`` equivalent.
+
+The reference constructs every component from a JSON config via the
+AllenNLP registry and trains with ``allennlp train <config> -s <dir>
+--include-package MemVul`` (reference: README.md:140-145).  This module
+reads the same config *shape* (``dataset_reader`` / ``model`` /
+``trainer`` / ``train_data_path`` / ... keys, ``"type"`` registry
+selection) and builds the TPU-native components:
+
+* ``build_tokenizer`` / ``build_reader`` — via the Registrable registry;
+* ``build_model`` — ``model_memory`` → :class:`MemoryModel`,
+  ``model_single`` → :class:`SingleModel`, ``model_cnn`` →
+  :class:`TextCNN`, with an ``encoder`` sub-config mapping onto
+  :class:`BertConfig` (dtype names resolved to jnp dtypes);
+* ``train_from_config`` — full train run + ``model.tar.gz`` archive of
+  the best weights (the serialization-dir contract);
+* ``evaluate_from_archive`` — the ``predict_memory.py``/
+  ``predict_single.py`` flow from an archive with config overrides.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def encoder_config(cfg: Optional[Dict[str, Any]], vocab_size: Optional[int] = None):
+    """``{"preset": "base"|"tiny", "dtype": "bfloat16", ...}`` → BertConfig."""
+    from .models import BertConfig
+
+    cfg = dict(cfg or {})
+    preset = cfg.pop("preset", "base")
+    dtype = cfg.pop("dtype", None)
+    if dtype is not None and isinstance(dtype, str):
+        cfg["dtype"] = DTYPES[dtype]
+    elif dtype is not None:
+        cfg["dtype"] = dtype
+    if vocab_size is not None:
+        cfg.setdefault("vocab_size", vocab_size)
+    factory = {"tiny": BertConfig.tiny, "base": BertConfig.base}[preset]
+    return factory(**cfg)
+
+
+def build_tokenizer(cfg: Optional[Dict[str, Any]]):
+    from .data.tokenizer import TextTokenizer
+
+    return TextTokenizer.from_config(cfg or {})
+
+
+def build_reader(cfg: Optional[Dict[str, Any]]):
+    from .data.readers import DatasetReader
+
+    cfg = dict(cfg or {})
+    cfg.setdefault("type", "reader_memory")
+    return DatasetReader.from_config(cfg)
+
+
+def build_model(model_cfg: Dict[str, Any], vocab_size: int):
+    """Construct the model module named by ``model_cfg["type"]``."""
+    from .models import MemoryModel, SingleModel
+    from .models.textcnn import TextCNN
+
+    cfg = dict(model_cfg or {})
+    cfg.pop("pretrained_checkpoint", None)  # handled by the caller
+    model_type = cfg.pop("type", "model_memory")
+    if model_type == "model_memory":
+        return MemoryModel(
+            encoder_config(cfg.pop("encoder", None), vocab_size), **cfg
+        )
+    if model_type == "model_single":
+        return SingleModel(
+            encoder_config(cfg.pop("encoder", None), vocab_size), **cfg
+        )
+    if model_type == "model_cnn":
+        cfg.pop("encoder", None)
+        return TextCNN(vocab_size=vocab_size, **cfg)
+    raise ValueError(f"unknown model type {model_type!r}")
+
+
+def init_params(model, seed: int = 0):
+    """Initialize parameters with the dummy-batch shapes each model needs."""
+    from .models import MemoryModel
+
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    rng = jax.random.PRNGKey(seed)
+    if isinstance(model, MemoryModel):
+        return model.init(rng, dummy, dummy)
+    return model.init(rng, dummy)
+
+
+def load_pretrained_encoder(params, checkpoint: Union[str, Path]):
+    """Transplant a further-pretrained encoder (the MLM subsystem's output,
+    reference: custom_PTM_embedder.py:95-99 loading ``out_wwm/``)."""
+    from flax import serialization
+
+    from .pretrain.mlm import transplant_encoder
+
+    path = Path(checkpoint)
+    if path.is_dir():
+        path = path / "encoder.msgpack"
+    encoder_subtree = serialization.msgpack_restore(path.read_bytes())
+    return transplant_encoder(params, encoder_subtree)
+
+
+def save_encoder_checkpoint(encoder_params, out_dir: Union[str, Path]) -> Path:
+    """Persist an MLM-pretrained encoder for later transplant."""
+    from flax import serialization
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / "encoder.msgpack"
+    path.write_bytes(serialization.to_bytes(jax.device_get(encoder_params)))
+    return path
+
+
+def _tokenizer_file(tok_cfg: Optional[Dict[str, Any]]) -> Optional[str]:
+    tok_cfg = tok_cfg or {}
+    return tok_cfg.get("tokenizer_path") or tok_cfg.get("vocab_path")
+
+
+def train_from_config(
+    config: Dict[str, Any],
+    serialization_dir: Union[str, Path],
+    mesh=None,
+) -> Dict[str, Any]:
+    """Run a full training job described by a reference-shaped config and
+    archive the best model as ``<dir>/model.tar.gz``."""
+    from .archive import ARCHIVE_NAME, save_archive
+
+    serialization_dir = Path(serialization_dir)
+    serialization_dir.mkdir(parents=True, exist_ok=True)
+    (serialization_dir / "config.json").write_text(json.dumps(config, indent=2))
+
+    seed = int(config.get("random_seed", 2021))
+    tokenizer = build_tokenizer(config.get("tokenizer"))
+    reader = build_reader(config.get("dataset_reader"))
+    model_cfg = config.get("model") or {}
+    model = build_model(model_cfg, tokenizer.vocab_size)
+    params = init_params(model, seed)
+    if model_cfg.get("pretrained_checkpoint"):
+        ckpt = Path(model_cfg["pretrained_checkpoint"])
+        if ckpt.exists():
+            params = load_pretrained_encoder(params, ckpt)
+            logger.info("loaded further-pretrained encoder from %s", ckpt)
+        else:
+            logger.warning(
+                "pretrained_checkpoint %s missing — training from scratch", ckpt
+            )
+
+    trainer_cfg = dict(config.get("trainer") or {})
+    trainer_cfg.setdefault("seed", seed)
+    trainer_cfg["serialization_dir"] = str(serialization_dir)
+    model_type = model_cfg.get("type", "model_memory")
+
+    if model_type == "model_memory":
+        from .training.trainer import MemoryTrainer, TrainerConfig
+
+        trainer = MemoryTrainer(
+            model,
+            params,
+            tokenizer,
+            reader,
+            train_path=config["train_data_path"],
+            validation_path=config.get("validation_data_path"),
+            anchor_path=config.get("anchor_path")
+            or (config.get("dataset_reader") or {}).get("anchor_path"),
+            config=TrainerConfig(**trainer_cfg),
+            mesh=mesh,
+        )
+    else:
+        from .training.single_trainer import ClassifierTrainer, ClassifierTrainerConfig
+
+        trainer = ClassifierTrainer(
+            model,
+            params,
+            tokenizer,
+            reader,
+            train_path=config["train_data_path"],
+            validation_path=config.get("validation_data_path"),
+            config=ClassifierTrainerConfig(**trainer_cfg),
+            mesh=mesh,
+        )
+
+    result = trainer.train()
+    best = jax.device_get(trainer.best_params())
+    archived = dict(config)
+    archived["model"] = dict(model_cfg)
+    save_archive(
+        serialization_dir / ARCHIVE_NAME,
+        archived,
+        best,
+        tokenizer_file=_tokenizer_file(config.get("tokenizer")),
+    )
+    (serialization_dir / "metrics.json").write_text(
+        json.dumps(result, indent=2, default=float)
+    )
+    result["archive"] = str(serialization_dir / ARCHIVE_NAME)
+    return result
+
+
+def evaluate_from_archive(
+    archive_path: Union[str, Path],
+    test_path: Union[str, Path],
+    out_dir: Union[str, Path],
+    overrides: Optional[Union[str, Dict[str, Any]]] = None,
+    golden_file: Optional[Union[str, Path]] = None,
+    name: Optional[str] = None,
+    mesh=None,
+    use_mesh: bool = True,
+    thres: float = 0.5,
+) -> Dict[str, float]:
+    """The reference's eval flow: load archive with overrides, score the
+    test corpus, write ``{name}_result.json`` + ``{name}_metric_all.json``
+    (reference: predict_memory.py:49-114,159-197)."""
+    from .archive import load_archive
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    arch = load_archive(archive_path, overrides=overrides)
+    model_cfg = arch.config.get("model") or {}
+    model_type = model_cfg.get("type", "model_memory")
+    name = name or model_type
+    reader = build_reader(arch.config.get("dataset_reader"))
+    eval_cfg = arch.config.get("evaluation") or {}
+    batch_size = int(eval_cfg.get("batch_size", 512))
+    max_length = int(eval_cfg.get("max_length", 512))
+
+    out_results = out_dir / f"{name}_result.json"
+    out_metrics = out_dir / f"{name}_metric_all.json"
+    if model_type == "model_memory":
+        from .evaluate.predict_memory import test_siamese
+
+        golden = golden_file or (arch.config.get("dataset_reader") or {}).get(
+            "anchor_path"
+        )
+        if golden is None:
+            raise ValueError("memory-model evaluation needs a golden anchor file")
+        return test_siamese(
+            arch.model,
+            arch.params,
+            arch.tokenizer,
+            test_file=test_path,
+            golden_file=golden,
+            out_results=out_results,
+            out_metrics=out_metrics,
+            reader=reader,
+            mesh=mesh,
+            use_mesh=use_mesh,
+            batch_size=batch_size,
+            max_length=max_length,
+            thres=thres,
+        )
+    from .evaluate.predict_single import test_single
+
+    return test_single(
+        arch.model,
+        arch.params,
+        arch.tokenizer,
+        test_file=test_path,
+        out_results=out_results,
+        out_metrics=out_metrics,
+        reader=reader,
+        mesh=mesh,
+        use_mesh=use_mesh,
+        batch_size=batch_size,
+        max_length=max_length,
+    )

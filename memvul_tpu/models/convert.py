@@ -97,6 +97,15 @@ def convert_bert_state_dict(
             "bias": sd["embeddings.LayerNorm.bias"],
         },
     }
+    checkpoint_layers = {
+        int(k.split(".")[2]) for k in sd if k.startswith("encoder.layer.")
+    }
+    if checkpoint_layers and max(checkpoint_layers) + 1 != config.num_layers:
+        raise ValueError(
+            f"checkpoint has {max(checkpoint_layers) + 1} encoder layers but "
+            f"config.num_layers={config.num_layers} — depth mismatch would "
+            "silently truncate the converted model"
+        )
     layers = [_layer_params(sd, i, config) for i in range(config.num_layers)]
     if config.scan_layers:
         import jax

@@ -138,12 +138,9 @@ def transplant_encoder(classifier_params, encoder_subtree) -> Dict:
     (their encoder also lives under ``params/bert``) — the counterpart of
     the reference's pretrained_model_path loading
     (custom_PTM_embedder.py:95-99)."""
-    out = jax.device_get(classifier_params)
-    out = jax.tree_util.tree_map(lambda x: x, out)  # shallow copy tree
-    import copy
-
-    out = copy.deepcopy(out)
-    out["params"]["bert"] = copy.deepcopy(encoder_subtree)
+    out = dict(jax.device_get(classifier_params))
+    out["params"] = dict(out["params"])
+    out["params"]["bert"] = encoder_subtree
     return out
 
 
@@ -214,7 +211,9 @@ class MLMTrainer:
     def _batches(self, lines: List[str]) -> Iterator[Tuple[np.ndarray, ...]]:
         c = self.c
         order = self._np_rng.permutation(len(lines))
-        for start in range(0, len(lines) - c.batch_size + 1, c.batch_size):
+        for start in range(0, len(lines), c.batch_size):
+            # the trailing partial batch is padded with empty rows (pad-only
+            # rows yield no maskable positions, so they contribute no loss)
             texts = [lines[i] for i in order[start : start + c.batch_size]]
             ids = np.full((c.batch_size, c.max_length), self.tokenizer.pad_id, np.int32)
             mask = np.zeros_like(ids)
@@ -234,6 +233,8 @@ class MLMTrainer:
         lines = [
             l.strip() for l in open(corpus_path, encoding="utf-8") if l.strip()
         ]
+        if not lines:
+            raise ValueError(f"MLM corpus {corpus_path} is empty")
         logger.info("MLM corpus: %d lines", len(lines))
         rng = jax.random.PRNGKey(c.seed)
         history: List[float] = []

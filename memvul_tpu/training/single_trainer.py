@@ -1,0 +1,279 @@
+"""Training loop for the single-text classifiers (MemVul-m, TextCNN).
+
+The reference trains these with AllenNLP's stock ``GradientDescentTrainer``
+(config_single.json uses the default trainer, metric ``+pos_f1-score``,
+batch 64; TextCNN/config_cnn.json uses Adam lr 1e-3).  The loop here is
+the TPU shape of the same contract: one jitted CE step, negatives
+re-subsampled every epoch by re-reading the reader, per-epoch validation
+scored through :class:`SinglePredictor`, patience-based early stopping and
+best-model checkpointing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.batching import LABELS_BINARY, CachedEncoder, batches_from_instances, prefetch
+from ..data.readers import DatasetReader
+from ..models.losses import masked_cross_entropy
+from ..parallel.mesh import replicate, shard_batch
+from .checkpoint import MetricTracker, TrainCheckpointer
+from .metrics import RunningClassification
+from .optim import make_optimizer
+
+logger = logging.getLogger(__name__)
+
+
+def make_classifier_step(model, tx):
+    """One CE optimizer step over a single padded batch."""
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply(
+            params, batch["sample1"], deterministic=False, rngs={"dropout": rng}
+        )
+        loss = masked_cross_entropy(
+            logits.astype(jnp.float32), batch["label"], batch["weight"]
+        )
+        return loss, logits
+
+    def step(params, opt_state, batch, rng):
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, rng
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates
+        )
+        return params, opt_state, loss, logits
+
+    return step
+
+
+@dataclasses.dataclass
+class ClassifierTrainerConfig:
+    num_epochs: int = 10
+    patience: Optional[int] = 10
+    validation_metric: str = "+pos_f1-score"
+    batch_size: int = 64
+    max_length: int = 256
+    eval_batch_size: int = 512
+    eval_max_length: int = 512
+    warmup_steps: int = 0
+    total_steps: Optional[int] = None
+    base_lr: float = 2e-5
+    group_lrs: Optional[Dict[str, float]] = None
+    grad_clip_norm: Optional[float] = 1.0
+    weight_decay: float = 0.0
+    seed: int = 2021
+    serialization_dir: Optional[str] = None
+    keep_checkpoints: int = 1
+    steps_per_epoch: Optional[int] = None
+
+
+class ClassifierTrainer:
+    """Shared trainer for any model whose forward is
+    ``apply(params, sample1) -> [B, num_classes]`` (SingleModel, TextCNN)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer,
+        reader: DatasetReader,
+        train_path: Union[str, Path],
+        validation_path: Optional[Union[str, Path]] = None,
+        config: Optional[ClassifierTrainerConfig] = None,
+        mesh=None,
+    ) -> None:
+        self.model = model
+        self.config = config or ClassifierTrainerConfig()
+        self.tokenizer = tokenizer
+        self.reader = reader
+        self.train_path = str(train_path)
+        self.validation_path = str(validation_path) if validation_path else None
+        self.mesh = mesh
+
+        c = self.config
+        self.encoder = CachedEncoder(tokenizer, max_length=c.max_length)
+        self.tx, opt_state = make_optimizer(
+            params,
+            group_lrs=c.group_lrs,
+            base_lr=c.base_lr,
+            warmup_steps=c.warmup_steps,
+            total_steps=c.total_steps,
+            grad_clip_norm=c.grad_clip_norm,
+            weight_decay=c.weight_decay,
+        )
+        if mesh is not None:
+            params = replicate(params, mesh)
+            opt_state = replicate(opt_state, mesh)
+        self.params = params
+        self.opt_state = opt_state
+        self.rng = jax.random.PRNGKey(c.seed)
+        self.step = 0
+        self.epoch = 0
+        self.tracker = MetricTracker(c.validation_metric, c.patience)
+        self.checkpointer = (
+            TrainCheckpointer(c.serialization_dir, c.keep_checkpoints)
+            if c.serialization_dir
+            else None
+        )
+        self.metrics_history: List[Dict[str, Any]] = []
+        self._step_fn = jax.jit(make_classifier_step(self.model, self.tx))
+
+    # -- data ----------------------------------------------------------------
+
+    def _batches(self) -> Iterator[Dict]:
+        c = self.config
+        batches = batches_from_instances(
+            self.reader.read(self.train_path, split="train"),
+            self.encoder,
+            batch_size=c.batch_size,
+            label_map=LABELS_BINARY,
+            pad_to_max=True,
+        )
+        for batch in prefetch(batches, depth=8):
+            batch.pop("meta", None)
+            if self.mesh is not None:
+                batch = shard_batch(batch, self.mesh)
+            yield batch
+
+    # -- epochs --------------------------------------------------------------
+
+    def train_epoch(self) -> Dict[str, float]:
+        c = self.config
+        running = RunningClassification(2, ["neg", "pos"])
+        losses: List[float] = []
+        started = time.perf_counter()
+        for i, batch in enumerate(self._batches()):
+            if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
+                break
+            self.rng, step_rng = jax.random.split(self.rng)
+            self.params, self.opt_state, loss, logits = self._step_fn(
+                self.params, self.opt_state, batch, step_rng
+            )
+            loss = float(loss)
+            if np.isnan(loss):
+                raise FloatingPointError(f"NaN loss at step {self.step}")
+            losses.append(loss)
+            running.update(
+                np.asarray(logits.argmax(axis=-1)).reshape(-1),
+                np.asarray(batch["label"]).reshape(-1),
+                np.asarray(batch["weight"]).reshape(-1),
+            )
+            self.step += 1
+        metrics = running.compute()
+        metrics["loss"] = float(np.mean(losses)) if losses else 0.0
+        metrics["epoch_seconds"] = time.perf_counter() - started
+        metrics["num_steps"] = len(losses)
+        return metrics
+
+    def validate(self) -> Dict[str, float]:
+        if not self.validation_path:
+            return {}
+        c = self.config
+        if not hasattr(self, "_val_predictor"):
+            from ..evaluate.predict_single import SinglePredictor
+
+            self._val_predictor = SinglePredictor(
+                self.model,
+                self.params,
+                self.tokenizer,
+                mesh=self.mesh,
+                batch_size=c.eval_batch_size,
+                max_length=c.eval_max_length,
+            )
+        predictor = self._val_predictor
+        predictor.params = self.params
+        import tempfile
+
+        out_dir = (
+            Path(c.serialization_dir)
+            if c.serialization_dir
+            else Path(tempfile.mkdtemp(prefix="memvul_val_"))
+        )
+        out = out_dir / f"validation_epoch_{self.epoch}.json"
+        measured = predictor.predict_file(
+            self.reader, self.validation_path, out, split="validation"
+        )
+        # reference metric names (model_single.py metrics: +pos_f1-score)
+        rename = {"f1": "pos_f1-score", "prec": "pos_precision", "pd&recall": "pos_recall"}
+        return {rename.get(k, k): v for k, v in measured.items()}
+
+    def train(self) -> Dict[str, Any]:
+        c = self.config
+        self.maybe_restore()
+        while self.epoch < c.num_epochs:
+            epoch_metrics: Dict[str, Any] = {"epoch": self.epoch}
+            epoch_metrics.update(
+                {f"training_{k}": v for k, v in self.train_epoch().items()}
+            )
+            val = self.validate()
+            epoch_metrics.update({f"validation_{k}": v for k, v in val.items()})
+            self.metrics_history.append(epoch_metrics)
+            logger.info("epoch %d: %s", self.epoch, epoch_metrics)
+            is_best = True
+            if val:
+                is_best = self.tracker.update(val, self.epoch)
+            if self.checkpointer is not None:
+                self.checkpointer.save(
+                    self.epoch, self._state_dict(), is_best=is_best,
+                    metadata=epoch_metrics,
+                )
+            self.epoch += 1
+            if val and self.tracker.should_stop():
+                logger.info("early stopping at epoch %d", self.epoch)
+                break
+        return {
+            "best_epoch": self.tracker.best_epoch,
+            "best_validation": self.tracker.best,
+            "history": self.metrics_history,
+        }
+
+    # -- state ---------------------------------------------------------------
+
+    def _state_dict(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "rng": jax.device_get(self.rng),
+            "meta": {
+                "step": self.step,
+                "epoch": self.epoch,
+                "tracker": self.tracker.state_dict(),
+            },
+        }
+
+    def maybe_restore(self) -> bool:
+        if self.checkpointer is None:
+            return False
+        restored = self.checkpointer.restore_latest(self._state_dict())
+        if restored is None:
+            return False
+        _, state = restored
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.rng = jnp.asarray(state["rng"])
+        meta = state["meta"]
+        self.step = int(meta["step"])
+        self.epoch = int(meta["epoch"]) + 1
+        self.tracker.load_state_dict(dict(meta["tracker"]))
+        if self.mesh is not None:
+            self.params = replicate(self.params, self.mesh)
+            self.opt_state = replicate(self.opt_state, self.mesh)
+        logger.info("restored checkpoint at epoch %d", self.epoch - 1)
+        return True
+
+    def best_params(self):
+        if self.checkpointer is None:
+            return self.params
+        state = self.checkpointer.restore_best(self._state_dict())
+        return state["params"] if state is not None else self.params

@@ -111,6 +111,10 @@ class TrainerConfig:
     serialization_dir: Optional[str] = None
     keep_checkpoints: int = 1
     steps_per_epoch: Optional[int] = None  # cap (useful for tests/smoke)
+    # MemVul-o ablation: False freezes the first epoch's pair sample and
+    # reuses it every epoch (the reference disables its reset_dataloader
+    # callback, config_no_online.json:77-79)
+    online_resample: bool = True
 
 
 class MemoryTrainer:
@@ -171,11 +175,24 @@ class MemoryTrainer:
 
     # -- data ----------------------------------------------------------------
 
+    def _train_instances(self):
+        """The epoch's pair stream.  With ``online_resample`` off the first
+        epoch's sampled pairs are frozen and replayed every epoch (instances
+        are small host dicts; batches/stacks are still rebuilt per epoch so
+        nothing epoch-sized is pinned on device)."""
+        if self.config.online_resample:
+            return self.reader.read(self.train_path, split="train")
+        if not hasattr(self, "_frozen_instances"):
+            self._frozen_instances = list(
+                self.reader.read(self.train_path, split="train")
+            )
+        return iter(self._frozen_instances)
+
     def _microbatch_stacks(self) -> Iterator[Dict]:
         """Group the epoch's pair stream into [K, B, L] stacks."""
         c = self.config
         batches = batches_from_instances(
-            self.reader.read(self.train_path, split="train"),
+            self._train_instances(),
             self.encoder,
             batch_size=c.batch_size,
             label_map=LABELS_SIAMESE,

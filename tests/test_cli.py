@@ -1,0 +1,274 @@
+"""CLI + config-driven construction + archive round-trip.
+
+Covers the reference's L5 contract: ``allennlp train <config> -s <dir>``
+(→ ``python -m memvul_tpu train``), the archived-config override merge
+used by the eval scripts (reference: predict_memory.py:60-67), and the
+model.tar.gz round-trip.
+"""
+
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from memvul_tpu.__main__ import main
+from memvul_tpu.archive import load_archive, save_archive
+from memvul_tpu.build import build_model, encoder_config, init_params
+from memvul_tpu.config import loads_config
+from memvul_tpu.data.synthetic import build_workspace
+
+CONFIGS_DIR = Path(__file__).resolve().parent.parent / "configs"
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("cli"), seed=11)
+
+
+def tiny_memory_config(ws, **trainer_kw):
+    trainer = {
+        "num_epochs": 1,
+        "patience": 2,
+        "batch_size": 4,
+        "grad_accum": 2,
+        "max_length": 48,
+        "eval_batch_size": 8,
+        "eval_max_length": 48,
+        "warmup_steps": 2,
+        "steps_per_epoch": 3,
+    }
+    trainer.update(trainer_kw)
+    return {
+        "random_seed": 2021,
+        "tokenizer": {"type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"]},
+        "dataset_reader": {
+            "type": "reader_memory",
+            "sample_neg": 1.0,
+            "same_diff_ratio": {"same": 2, "diff": 2},
+            "cve_path": ws["paths"]["cve"],
+            "anchor_path": ws["paths"]["anchors"],
+        },
+        "train_data_path": ws["paths"]["train"],
+        "validation_data_path": ws["paths"]["validation"],
+        "model": {
+            "type": "model_memory",
+            "encoder": {"preset": "tiny", "vocab_size": 4096},
+            "use_header": True,
+            "header_dim": 32,
+            "temperature": 0.1,
+        },
+        "trainer": trainer,
+        "evaluation": {"batch_size": 8, "max_length": 48},
+    }
+
+
+# -- config parsing / model construction --------------------------------------
+
+def test_shipped_configs_parse():
+    files = sorted(CONFIGS_DIR.glob("*.json"))
+    assert len(files) >= 8
+    for f in files:
+        cfg = loads_config(f.read_text())
+        assert isinstance(cfg, dict) and cfg
+
+
+def test_encoder_config_dtype_and_preset():
+    cfg = encoder_config({"preset": "tiny", "dtype": "bfloat16"}, vocab_size=777)
+    assert cfg.dtype == jnp.bfloat16
+    assert cfg.vocab_size == 777
+    assert cfg.num_layers == 2
+
+
+def test_build_model_types():
+    from memvul_tpu.models import MemoryModel, SingleModel
+    from memvul_tpu.models.textcnn import TextCNN
+
+    mem = build_model(
+        {"type": "model_memory", "encoder": {"preset": "tiny"}}, vocab_size=100
+    )
+    single = build_model(
+        {"type": "model_single", "encoder": {"preset": "tiny"}}, vocab_size=100
+    )
+    cnn = build_model({"type": "model_cnn", "embed_dim": 16}, vocab_size=100)
+    assert isinstance(mem, MemoryModel)
+    assert isinstance(single, SingleModel)
+    assert isinstance(cnn, TextCNN)
+    with pytest.raises(ValueError):
+        build_model({"type": "nope"}, vocab_size=10)
+
+
+# -- archive round-trip --------------------------------------------------------
+
+def test_archive_roundtrip_with_overrides(ws, tmp_path):
+    model_cfg = {"type": "model_memory", "encoder": {"preset": "tiny", "vocab_size": 4096}, "header_dim": 32}
+    config = {
+        "tokenizer": {"type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"]},
+        "model": model_cfg,
+        "evaluation": {"batch_size": 512, "max_length": 512},
+    }
+    model = build_model(model_cfg, 4096)
+    params = init_params(model, seed=0)
+    path = save_archive(
+        tmp_path / "model.tar.gz", config, params,
+        tokenizer_file=ws["paths"]["tokenizer"],
+    )
+    arch = load_archive(path, overrides={"evaluation": {"batch_size": 8}})
+    assert arch.config["evaluation"]["batch_size"] == 8
+    assert arch.config["evaluation"]["max_length"] == 512  # deep merge keeps rest
+    # params survive serialization bit-exactly
+    orig = np.asarray(params["params"]["pair_kernel"])
+    back = np.asarray(arch.params["params"]["pair_kernel"])
+    np.testing.assert_array_equal(orig, back)
+    # the archived tokenizer is self-contained (loaded from inside the tar)
+    assert arch.tokenizer.vocab_size == ws["tokenizer"].vocab_size
+
+
+# -- end-to-end CLI ------------------------------------------------------------
+
+def test_cli_train_then_evaluate_memory(ws, tmp_path):
+    config = tiny_memory_config(ws)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+
+    rc = main(["train", str(cfg_path), "-s", str(ser_dir)])
+    assert rc == 0
+    assert (ser_dir / "model.tar.gz").exists()
+    assert (ser_dir / "metrics.json").exists()
+
+    eval_dir = tmp_path / "eval"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(eval_dir), "--name", "memvul", "--no-mesh",
+        "--overrides", json.dumps({"evaluation": {"batch_size": 8, "max_length": 48}}),
+    ])
+    assert rc == 0
+    result_file = eval_dir / "memvul_result.json"
+    metric_file = eval_dir / "memvul_metric_all.json"
+    assert result_file.exists() and metric_file.exists()
+    metrics = json.loads(metric_file.read_text())
+    for key in ("TP", "FN", "TN", "FP", "prec", "f1", "auc"):
+        assert key in metrics
+
+
+def test_cli_train_single_classifier(ws, tmp_path):
+    config = {
+        "random_seed": 2021,
+        "tokenizer": {"type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"]},
+        "dataset_reader": {"type": "reader_single", "sample_neg": 1.0},
+        "train_data_path": ws["paths"]["train"],
+        "validation_data_path": ws["paths"]["validation"],
+        "model": {
+            "type": "model_single",
+            "encoder": {"preset": "tiny", "vocab_size": 4096},
+            "header_dim": 32,
+        },
+        "trainer": {
+            "num_epochs": 1, "batch_size": 4, "max_length": 48,
+            "eval_batch_size": 8, "eval_max_length": 48,
+            "steps_per_epoch": 3,
+        },
+        "evaluation": {"batch_size": 8, "max_length": 48},
+    }
+    cfg_path = tmp_path / "config_single.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out_single"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+
+    eval_dir = tmp_path / "eval_single"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(eval_dir), "--no-mesh",
+    ])
+    assert rc == 0
+    metrics = json.loads((eval_dir / "model_single_metric_all.json").read_text())
+    assert "f1" in metrics
+
+
+def test_cli_train_textcnn(ws, tmp_path):
+    from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+    from memvul_tpu.data.tokenizer import WordTokenizer
+
+    reports, _ = generate_corpus(seed=3)
+    vocab_path = tmp_path / "word_vocab.json"
+    WordTokenizer.train_from_corpus(
+        corpus_texts(reports), max_vocab=500, save_path=vocab_path
+    )
+    config = {
+        "random_seed": 2021,
+        "tokenizer": {"type": "word", "vocab_path": str(vocab_path)},
+        "dataset_reader": {"type": "reader_single", "sample_neg": 1.0},
+        "train_data_path": ws["paths"]["train"],
+        "validation_data_path": ws["paths"]["validation"],
+        "model": {
+            "type": "model_cnn", "embed_dim": 16, "num_filters": 8,
+            "header_dim": 16,
+        },
+        "trainer": {
+            "num_epochs": 1, "batch_size": 4, "max_length": 48,
+            "eval_batch_size": 8, "eval_max_length": 48,
+            "base_lr": 1e-3, "steps_per_epoch": 3,
+        },
+    }
+    cfg_path = tmp_path / "config_cnn.json"
+    cfg_path.write_text(json.dumps(config))
+    assert main(["train", str(cfg_path), "-s", str(tmp_path / "out_cnn")]) == 0
+    assert (tmp_path / "out_cnn" / "model.tar.gz").exists()
+
+
+def test_cli_build_data(tmp_path):
+    import csv
+
+    from memvul_tpu.data.synthetic import generate_corpus, research_view_records
+
+    reports, cve_dict = generate_corpus(seed=9)
+    csv_path = tmp_path / "all_samples.csv"
+    with open(csv_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(reports[0].keys()))
+        writer.writeheader()
+        writer.writerows(reports)
+    cve_path = tmp_path / "CVE_dict.json"
+    cve_path.write_text(json.dumps(cve_dict))
+    cwe_path = tmp_path / "1000.csv"
+    records = research_view_records()
+    with open(cwe_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(records[0].keys()))
+        writer.writeheader()
+        writer.writerows(records)
+
+    out = tmp_path / "data"
+    rc = main([
+        "build-data", "--csv", str(csv_path), "--cve-dict", str(cve_path),
+        "--cwe-csv", str(cwe_path), "--out", str(out),
+    ])
+    assert rc == 0
+    for name in (
+        "train_project.json", "validation_project.json", "test_project.json",
+        "train_project_mlm.txt", "CWE_anchor_golden_project.json",
+    ):
+        assert (out / name).exists(), name
+
+
+def test_online_resample_off_freezes_pairs(ws, tmp_path):
+    """MemVul-o: with online_resample false the epoch stream is identical
+    across epochs (the reference comments out reset_dataloader)."""
+    from memvul_tpu.build import build_model, build_reader, build_tokenizer, init_params
+    from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+    config = tiny_memory_config(ws, online_resample=False)
+    tokenizer = build_tokenizer(config["tokenizer"])
+    reader = build_reader(config["dataset_reader"])
+    model = build_model(config["model"], tokenizer.vocab_size)
+    params = init_params(model)
+    trainer = MemoryTrainer(
+        model, params, tokenizer, reader,
+        train_path=config["train_data_path"],
+        config=TrainerConfig(**{**config["trainer"], "online_resample": False}),
+    )
+    first = [np.asarray(s["sample1"]["input_ids"]) for s in trainer._microbatch_stacks()]
+    second = [np.asarray(s["sample1"]["input_ids"]) for s in trainer._microbatch_stacks()]
+    assert len(first) == len(second)
+    for a, b in zip(first, second):
+        np.testing.assert_array_equal(a, b)
