@@ -75,13 +75,18 @@ class BertEmbeddings(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids, deterministic: bool):
+    def __call__(
+        self, input_ids, token_type_ids, deterministic: bool, position_ids=None
+    ):
         c = self.config
         word = nn.Embed(
             c.vocab_size, c.hidden_size, embedding_init=_dense_init(c),
             dtype=c.dtype, name="word_embeddings",
         )(input_ids)
-        position_ids = jnp.arange(input_ids.shape[-1])[None, :]
+        if position_ids is None:
+            # explicit ids matter under sequence parallelism, where each
+            # shard sees a slice and must use its global offsets
+            position_ids = jnp.arange(input_ids.shape[-1])[None, :]
         pos = nn.Embed(
             c.max_position_embeddings, c.hidden_size, embedding_init=_dense_init(c),
             dtype=c.dtype, name="position_embeddings",
@@ -198,6 +203,7 @@ class BertEncoder(nn.Module):
         attention_mask,
         token_type_ids=None,
         deterministic: bool = True,
+        position_ids=None,
     ):
         c = self.config
         if input_ids.shape[-1] > c.max_position_embeddings:
@@ -209,7 +215,7 @@ class BertEncoder(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
         hidden = BertEmbeddings(c, name="embeddings")(
-            input_ids, token_type_ids, deterministic
+            input_ids, token_type_ids, deterministic, position_ids=position_ids
         )
         bias = mask_to_bias(attention_mask, dtype=c.dtype)
         return BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)

@@ -48,11 +48,17 @@ def dot_product_attention(
         # attention dropout route through the XLA formulation instead of
         # silently dropping the dropout
     elif impl == "ring":
-        raise ValueError(
-            "impl='ring' is sequence-parallel attention: it runs via "
-            "memvul_tpu.parallel.ring under shard_map with the sequence "
-            "axis sharded, not through dot_product_attention"
-        )
+        # sequence-parallel: caller must be inside shard_map with the
+        # "seq" axis bound to the sharded sequence dim; the bias travels
+        # around the ring with its key/value block
+        if not deterministic and dropout_rate > 0.0:
+            raise ValueError(
+                "ring attention has no dropout support — set "
+                "attention_dropout=0 for sequence-parallel training"
+            )
+        from ..parallel.ring import ring_attention
+
+        return ring_attention(query, key, value, key_bias=bias, axis_name="seq")
     elif impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r}")
     return _xla_attention(

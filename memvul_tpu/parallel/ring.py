@@ -1,0 +1,193 @@
+"""Sequence-parallel ring attention over a device mesh axis.
+
+The reference handles long inputs by *folding* (segments encoded
+independently, custom_PTM_embedder.py:244-381) — no true long-context
+attention exists there.  This module supplies the TPU-native stretch
+capability: the sequence axis is sharded across devices, each device
+holds a query block plus one key/value block, and the key/value blocks
+rotate around the ring via ``lax.ppermute`` while a streaming
+(online-softmax) accumulator builds the exact full-sequence attention
+output.  Communication rides the ICI ring; compute on the current block
+overlaps the permute of the next.
+
+Numerics: block accumulation runs in float32 with the standard
+running-max/denominator rescaling, so the result matches single-device
+softmax attention to bf16/fp32 tolerance regardless of ring order.
+
+Usage:
+* :func:`ring_attention` — the per-shard op, call it inside
+  ``shard_map`` with a bound sequence axis name;
+* :func:`make_ring_attention` — binds a mesh + axis and returns a
+  drop-in ``(q, k, v, mask) -> out`` callable operating on globally
+  sharded arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def ring_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    key_mask: Optional[jax.Array] = None,
+    key_bias: Optional[jax.Array] = None,
+    axis_name: str = "seq",
+    axis_size: Optional[int] = None,
+) -> jax.Array:
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+
+    Per-shard shapes: query/key/value [B, T_local, H, Dh]; ``key_mask``
+    [B, T_local] marks real key positions (1) vs padding (0) —
+    alternatively pass ``key_bias``, an additive bias broadcastable to
+    [B, 1, 1, T_local] (the encoder's ``mask_to_bias`` output, already
+    sharded on its key dim).  Returns the local query block's attention
+    output [B, T_local, H, Dh] in the dtype of ``query``.  Must run
+    inside ``shard_map`` with ``axis_name`` bound.
+    """
+    if axis_size is None:
+        axis_size = jax.lax.psum(1, axis_name)
+    depth = query.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(depth, jnp.float32))
+    neg = jnp.finfo(jnp.float32).min
+
+    b, t_q, h, _ = query.shape
+    if key_bias is not None:
+        # only key-position biases can ride the ring: a bias with a real
+        # query or head dim cannot travel with the rotating key block
+        for dim in (-3, -2):  # the head and query dims must broadcast
+            if key_bias.ndim >= -dim and key_bias.shape[dim] != 1:
+                raise ValueError(
+                    "ring attention supports key-only bias (broadcastable "
+                    f"to [B, 1, 1, T_k]); got shape {key_bias.shape}"
+                )
+        key_bias = jnp.broadcast_to(
+            key_bias.astype(jnp.float32), (b, 1, 1, key.shape[1])
+        )
+    else:
+        if key_mask is None:
+            key_mask = jnp.ones(key.shape[:2], jnp.int32)
+        key_bias = jnp.where(key_mask[:, None, None, :] > 0, 0.0, neg).astype(
+            jnp.float32
+        )  # [B, 1, 1, T_k]
+
+    acc = jnp.zeros((b, t_q, h, depth), jnp.float32)
+    row_max = jnp.full((b, h, t_q), neg, jnp.float32)
+    denom = jnp.zeros((b, h, t_q), jnp.float32)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def accumulate(acc, row_max, denom, k, v, kb):
+        scores = (
+            jnp.einsum("bqhd,bkhd->bhqk", query, k).astype(jnp.float32) * scale
+            + kb
+        )
+        block_max = scores.max(axis=-1)
+        new_max = jnp.maximum(row_max, block_max)
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(scores - new_max[..., None])
+        denom = denom * correction + p.sum(axis=-1)
+        acc = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+        )
+        return acc, new_max, denom
+
+    def step(carry, _):
+        acc, row_max, denom, k, v, kb = carry
+        acc, row_max, denom = accumulate(acc, row_max, denom, k, v, kb)
+        # rotate the key/value block (and its mask bias) to the next device
+        k, v, kb = (
+            jax.lax.ppermute(x, axis_name, perm) for x in (k, v, kb)
+        )
+        return (acc, row_max, denom, k, v, kb), None
+
+    # scan covers axis_size-1 compute+rotate rounds; the final block is
+    # consumed without the (otherwise wasted) closing rotation
+    (acc, row_max, denom, key, value, key_bias), _ = jax.lax.scan(
+        step,
+        (acc, row_max, denom, key, value, key_bias),
+        None,
+        length=axis_size - 1,
+    )
+    acc, _, denom = accumulate(acc, row_max, denom, key, value, key_bias)
+    out = acc / jnp.maximum(denom.transpose(0, 2, 1)[..., None], 1e-30)
+    return out.astype(query.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "seq"):
+    """Bind ``ring_attention`` to a mesh: returns ``fn(q, k, v, mask)``
+    over *global* arrays with the sequence dim sharded on ``axis_name``
+    (batch/heads replicated across that axis)."""
+    axis_size = mesh.shape[axis_name]
+    spec_qkv = P(None, axis_name, None, None)
+    spec_mask = P(None, axis_name)
+
+    inner = functools.partial(
+        ring_attention, axis_name=axis_name, axis_size=axis_size
+    )
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_mask),
+        out_specs=spec_qkv,
+        check_rep=False,
+    )
+
+
+def encode_sequence_parallel(
+    model,
+    params,
+    input_ids: jax.Array,
+    attention_mask: jax.Array,
+    mesh: Mesh,
+    axis_name: str = "seq",
+) -> jax.Array:
+    """Run a :class:`BertEncoder` built with ``attention_impl="ring"``
+    with its *sequence* axis sharded over ``axis_name``.
+
+    Everything except attention is position-wise, so each device encodes
+    its sequence slice locally (with correct global position ids) and only
+    the attention step communicates — key/value blocks ride the ICI ring.
+    Inference path (``deterministic=True``); returns the full [B, T, H]
+    hidden states, sequence-sharded on ``axis_name``.
+    """
+    if model.config.attention_impl != "ring":
+        raise ValueError(
+            "sequence-parallel encoding needs attention_impl='ring' "
+            f"(got {model.config.attention_impl!r})"
+        )
+    b, t = input_ids.shape
+    n = mesh.shape[axis_name]
+    if t % n != 0:
+        raise ValueError(f"sequence length {t} not divisible by {axis_name}={n}")
+    if t > model.config.max_position_embeddings:
+        # the encoder's own guard only sees the local shard length inside
+        # shard_map; check the global length here or OOB position-embedding
+        # gathers would silently clamp
+        raise ValueError(
+            f"sequence length {t} exceeds max_position_embeddings="
+            f"{model.config.max_position_embeddings}"
+        )
+    position_ids = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+
+    def local(params, ids, mask, pos):
+        return model.apply(
+            params, ids, mask, position_ids=pos, deterministic=True
+        )
+
+    seq2 = P(None, axis_name)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), seq2, seq2, seq2),
+        out_specs=P(None, axis_name, None),
+        check_rep=False,
+    )
+    return fn(params, input_ids, attention_mask, position_ids)

@@ -1,0 +1,109 @@
+"""Tensor-parallel parameter sharding rules for the BERT encoder.
+
+The reference has no tensor parallelism (SURVEY §2.5) — this is the TPU
+build's scaling axis for larger encoders: attention heads and the FFN
+hidden dim are split over the ``model`` mesh axis (the Megatron layout),
+so each device holds a slice of every layer and XLA inserts the
+all-reduces after the attention-output and FFN-output matmuls.  Params
+not matched by a rule are replicated (embeddings, LayerNorms, poolers,
+classification heads — all small).
+
+Rules are path-suffix → trailing-dim partition specs, padded with
+``None`` on the left for any extra leading dims, which makes the same
+rules correct for both the per-layer layout (``layer_0/...``) and the
+scanned layout (stacked leaves with a leading [L] dim).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+# (path substring, spec for the *trailing* dims). Checked in order; first
+# match wins — keep more specific patterns first.
+DEFAULT_TP_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    # attention projections: DenseGeneral [H, heads, head_dim] — split heads
+    ("attention/query/kernel", (None, MODEL_AXIS, None)),
+    ("attention/key/kernel", (None, MODEL_AXIS, None)),
+    ("attention/value/kernel", (None, MODEL_AXIS, None)),
+    ("attention/query/bias", (MODEL_AXIS, None)),
+    ("attention/key/bias", (MODEL_AXIS, None)),
+    ("attention/value/bias", (MODEL_AXIS, None)),
+    # attention output: DenseGeneral [heads, head_dim, H] — split heads
+    # (row-parallel: XLA all-reduces the partial sums)
+    ("attention/output/kernel", (MODEL_AXIS, None, None)),
+    # FFN up-projection [H, I] — split the hidden dim (column-parallel)
+    ("intermediate/kernel", (None, MODEL_AXIS)),
+    ("intermediate/bias", (MODEL_AXIS,)),
+    # FFN down-projection [I, H] — split the hidden dim (row-parallel).
+    # attention/output matched above, so this only hits the FFN output.
+    ("output/kernel", (MODEL_AXIS, None)),
+)
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", k)) for k in path)
+
+
+def tp_spec_for(
+    path_str: str,
+    ndim: int,
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = DEFAULT_TP_RULES,
+) -> P:
+    """Partition spec for one param leaf (replicated when no rule hits)."""
+    for needle, trailing in rules:
+        if needle in path_str:
+            if len(trailing) > ndim:
+                # e.g. a bias rule written for the unscanned layout hitting
+                # a lower-rank leaf — replicate rather than mis-shard
+                return P()
+            pad = ndim - len(trailing)
+            return P(*((None,) * pad + tuple(trailing)))
+    return P()
+
+
+def param_specs(params, rules=DEFAULT_TP_RULES):
+    """PartitionSpec pytree matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: tp_spec_for(_path_str(path), leaf.ndim, rules), params
+    )
+
+
+def shard_params(params, mesh: Mesh, rules=DEFAULT_TP_RULES):
+    """Place params on the mesh with tensor-parallel shardings (replicated
+    over every axis except ``model``).  Falls back to full replication
+    when the mesh has no ``model`` axis."""
+    if MODEL_AXIS not in mesh.axis_names:
+        from .mesh import replicate
+
+        return replicate(params, mesh)
+    specs = param_specs(params, rules)
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        params,
+        specs,
+    )
+
+
+def validate_divisibility(params, mesh: Mesh, rules=DEFAULT_TP_RULES) -> List[str]:
+    """Paths whose sharded dim is not divisible by the model-axis size —
+    useful as a pre-flight check before ``shard_params``."""
+    if MODEL_AXIS not in mesh.axis_names:
+        return []
+    size = mesh.shape[MODEL_AXIS]
+    bad: List[str] = []
+
+    def check(path, leaf):
+        spec = tp_spec_for(_path_str(path), leaf.ndim, rules)
+        for dim, axis in enumerate(spec):
+            if axis == MODEL_AXIS and leaf.shape[dim] % size != 0:
+                bad.append(f"{_path_str(path)}[{dim}]={leaf.shape[dim]} % {size}")
+        return leaf
+
+    jax.tree_util.tree_map_with_path(check, params)
+    return bad

@@ -1,0 +1,133 @@
+"""Paper-analysis utilities (reference: utils.py:186-235,415-572)."""
+
+import pytest
+
+from memvul_tpu.data.analysis import (
+    count_attack_steps,
+    cumulative_cwe_distribution,
+    cwe_report_distribution,
+    delta_days_histogram,
+    fix_timestamp,
+    join_positives_with_cve,
+    keyword_match_study,
+    matches_security_keyword,
+    repo_stats,
+)
+from memvul_tpu.data.cwe import build_cwe_tree
+from memvul_tpu.data.synthetic import generate_corpus, research_view_records
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return generate_corpus(seed=7)
+
+
+def test_security_keyword_matching():
+    assert matches_security_keyword("possible buffer overflow in parser")
+    assert matches_security_keyword("XSS in the comment field")
+    assert matches_security_keyword("please fix CVE handling")  # \bcve\b
+    assert not matches_security_keyword("dark mode please")
+    assert not matches_security_keyword(None)
+
+
+def test_keyword_match_study_partitions(corpus):
+    reports, _ = corpus
+    counts = keyword_match_study(reports)
+    assert sum(counts.values()) == len(reports)
+    n_pos = sum(1 for r in reports if r["Security_Issue_Full"] == "1")
+    assert counts["pos_match"] + counts["pos_not_match"] == n_pos
+    # the synthetic vuln phrases are keyword-rich: most positives match
+    assert counts["pos_match"] > counts["pos_not_match"]
+
+
+def test_fix_timestamp():
+    assert fix_timestamp("2018-10-30 16:26:01 UTC") == "2018-10-30T16:26:01Z"
+    assert fix_timestamp("2018-10-30T16:26Z") == "2018-10-30T16:26Z"
+
+
+def test_delta_days_histogram_bins():
+    positives = [
+        # created == published → delta 0 → bin (-inf, 0]
+        {"Issue_Created_At": "2021-06-01T00:00:00Z", "Published_Date": "2021-06-01T00:00Z"},
+        # 3 days later → (0, 7]
+        {"Issue_Created_At": "2021-06-01T00:00:00Z", "Published_Date": "2021-06-04T00:00Z"},
+        # 200 days later → (180, +inf)
+        {"Issue_Created_At": "2021-01-01T00:00:00Z", "Published_Date": "2021-07-20T00:00Z"},
+    ]
+    hist = delta_days_histogram(positives)
+    assert hist["counts"] == [1, 1, 0, 0, 1]
+    assert hist["total"] == 3
+    assert abs(sum(hist["fractions"]) - 1.0) < 1e-9
+
+
+def test_delta_days_falls_back_to_cve_dict():
+    cve_dict = {"CVE-1": {"Published_Date": "2021-06-04T00:00Z"}}
+    positives = [
+        {"Issue_Created_At": "2021-06-01T00:00:00Z", "CVE_ID": "CVE-1"},
+        {"Issue_Created_At": "2021-06-01T00:00:00Z", "CVE_ID": "CVE-missing"},
+    ]
+    hist = delta_days_histogram(positives, cve_dict)
+    assert hist["total"] == 1  # the unresolvable record is skipped, not 0-binned
+    assert hist["counts"][1] == 1
+
+
+def test_join_and_distribution(corpus):
+    reports, cve_dict = corpus
+    pos_info = join_positives_with_cve(reports, cve_dict)
+    assert all(r["CWE_ID"] for r in pos_info)
+    assert all("CVE_Description" in r for r in pos_info)
+
+    tree = build_cwe_tree(research_view_records())
+    dist = cwe_report_distribution(pos_info, tree)
+    # counts add back up to the positive total
+    assert sum(v["#issue report"] for v in dist.values()) == len(pos_info)
+    # every synthetic CWE id resolves to an abstraction from the tree
+    for cwe_id, entry in dist.items():
+        assert entry["abstraction"] is not None, cwe_id
+        assert entry["#CVE"] == len(entry["CVE_distribution"])
+
+
+def test_distribution_handles_special_categories():
+    pos_info = [
+        {"CVE_ID": "CVE-1", "CWE_ID": "NVD-CWE-noinfo"},
+        {"CVE_ID": "CVE-2", "CWE_ID": None},
+    ]
+    dist = cwe_report_distribution(pos_info, {})
+    assert dist["NVD-CWE-noinfo"]["abstraction"] is None
+    assert dist["null"]["#issue report"] == 1
+
+
+def test_cumulative_distribution():
+    dist = {
+        "a": {"#issue report": 1}, "b": {"#issue report": 1},
+        "c": {"#issue report": 5}, "d": {"#issue report": 10},
+    }
+    points = cumulative_cwe_distribution(dist)
+    assert points == [(1, 0.5), (5, 0.75), (10, 1.0)]
+    assert cumulative_cwe_distribution({}) == []
+
+
+def test_count_attack_steps():
+    positives = [
+        {"Issue_Body": "PoC: run this script"},
+        {"Issue_Body": "Steps to reproduce: 1. open the app"},
+        {"Issue_Body": "it crashes sometimes"},
+    ]
+    out = count_attack_steps(positives)
+    assert out == {"total": 3, "with_attack_steps": 2}
+
+
+def test_repo_stats(corpus):
+    reports, _ = corpus
+    repo_info = {
+        f"org{i}/repo{i}": {
+            "stargazers_count": 10 * (i + 1), "watchers_count": 5,
+            "forks_count": 2, "subscribers_count": 1,
+        }
+        for i in range(7)  # org7/repo7 deliberately missing
+    }
+    stats = repo_stats(reports, repo_info)
+    assert stats["num_projects"] == 8
+    assert stats["missing_projects"] == ["org7/repo7"]
+    assert stats["star"]["median"] == 40.0
+    assert stats["fork"]["mean"] == 2.0

@@ -230,12 +230,26 @@ def test_flash_impl_falls_back_on_cpu(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
 
 
-def test_ring_impl_raises_with_guidance():
+def test_ring_impl_requires_bound_axis():
+    """impl='ring' dispatches to sequence-parallel attention, which only
+    works inside shard_map with the "seq" axis bound — outside, jax
+    reports the unbound axis (full coverage in test_ring_attention.py)."""
     from memvul_tpu.ops import dot_product_attention
 
     q = jnp.zeros((1, 4, 2, 8))
-    with pytest.raises(ValueError, match="shard_map"):
+    with pytest.raises(NameError, match="seq"):
         dot_product_attention(q, q, q, impl="ring")
+
+
+def test_ring_impl_rejects_dropout():
+    from memvul_tpu.ops import dot_product_attention
+
+    q = jnp.zeros((1, 4, 2, 8))
+    with pytest.raises(ValueError, match="dropout"):
+        dot_product_attention(
+            q, q, q, impl="ring", deterministic=False, dropout_rate=0.1,
+            dropout_rng=jax.random.PRNGKey(0),
+        )
 
 
 def test_pooler_dropout_active_in_training(rng):
