@@ -66,9 +66,15 @@ def preprocess(
     kept = [rec for rec in kept if by_project[rec["project"]] > 0]
 
     if normalize:
-        for rec in kept:
-            rec["Issue_Title"] = normalize_text(rec.get("Issue_Title") or "")
-            rec["Issue_Body"] = normalize_text(rec.get("Issue_Body") or "")
+        # batch through the parity-validated native normalizer when built
+        # (thread pool over documents); falls back to the Python pass table
+        from .native import normalize_batch
+
+        titles = normalize_batch([rec.get("Issue_Title") or "" for rec in kept])
+        bodies = normalize_batch([rec.get("Issue_Body") or "" for rec in kept])
+        for rec, title, body in zip(kept, titles, bodies):
+            rec["Issue_Title"] = title
+            rec["Issue_Body"] = body
     return kept
 
 

@@ -150,17 +150,21 @@ class ClassifierTrainer:
 
     def train_epoch(self) -> Dict[str, float]:
         c = self.config
+        from ..utils.profiling import StepTimer, device_memory_stats
+
         running = RunningClassification(2, ["neg", "pos"])
         losses: List[float] = []
+        timer = StepTimer()
         started = time.perf_counter()
         for i, batch in enumerate(self._batches()):
             if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                 break
             self.rng, step_rng = jax.random.split(self.rng)
-            self.params, self.opt_state, loss, logits = self._step_fn(
-                self.params, self.opt_state, batch, step_rng
-            )
-            loss = float(loss)
+            with timer.step():
+                self.params, self.opt_state, loss, logits = self._step_fn(
+                    self.params, self.opt_state, batch, step_rng
+                )
+                loss = float(loss)
             if np.isnan(loss):
                 raise FloatingPointError(f"NaN loss at step {self.step}")
             losses.append(loss)
@@ -174,6 +178,9 @@ class ClassifierTrainer:
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started
         metrics["num_steps"] = len(losses)
+        metrics.update(timer.summary())
+        for key, value in device_memory_stats().items():
+            metrics[f"memory_{key}"] = value
         return metrics
 
     def validate(self) -> Dict[str, float]:

@@ -115,6 +115,8 @@ class TrainerConfig:
     # reuses it every epoch (the reference disables its reset_dataloader
     # callback, config_no_online.json:77-79)
     online_resample: bool = True
+    # when set, epoch 0 is wrapped in a jax.profiler trace written here
+    profile_dir: Optional[str] = None
 
 
 class MemoryTrainer:
@@ -226,29 +228,39 @@ class MemoryTrainer:
 
     def train_epoch(self) -> Dict[str, float]:
         c = self.config
+        from ..utils.profiling import StepTimer, device_memory_stats, trace_context
+
         running = RunningClassification(2, ["same", "diff"])
         losses: List[float] = []
+        timer = StepTimer()
         started = time.perf_counter()
-        for i, stack in enumerate(self._microbatch_stacks()):
-            if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
-                break
-            self.rng, step_rng = jax.random.split(self.rng)
-            self.params, self.opt_state, loss, logits = self._train_step(
-                self.params, self.opt_state, stack, step_rng
-            )
-            loss = float(loss)
-            if np.isnan(loss):
-                raise FloatingPointError(f"NaN loss at step {self.step}")
-            losses.append(loss)
-            preds = np.asarray(logits.argmax(axis=-1)).reshape(-1)
-            labels = np.asarray(stack["label"]).reshape(-1)
-            weights = np.asarray(stack["weight"]).reshape(-1)
-            running.update(preds, labels, weights)
-            self.step += 1
+        trace_dir = c.profile_dir if (c.profile_dir and self.epoch == 0) else None
+        with trace_context(trace_dir):
+            for i, stack in enumerate(self._microbatch_stacks()):
+                if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
+                    break
+                self.rng, step_rng = jax.random.split(self.rng)
+                with timer.step():
+                    self.params, self.opt_state, loss, logits = self._train_step(
+                        self.params, self.opt_state, stack, step_rng
+                    )
+                    loss = float(loss)
+                if np.isnan(loss):
+                    raise FloatingPointError(f"NaN loss at step {self.step}")
+                losses.append(loss)
+                preds = np.asarray(logits.argmax(axis=-1)).reshape(-1)
+                labels = np.asarray(stack["label"]).reshape(-1)
+                weights = np.asarray(stack["weight"]).reshape(-1)
+                running.update(preds, labels, weights)
+                self.step += 1
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started
         metrics["num_steps"] = len(losses)
+        metrics.update(timer.summary())
+        # peak-memory-in-metrics behavior (reference: custom_trainer.py:674-679)
+        for key, value in device_memory_stats().items():
+            metrics[f"memory_{key}"] = value
         return metrics
 
     def validate(self) -> Dict[str, float]:

@@ -1,0 +1,5 @@
+from .profiling import (  # noqa: F401
+    StepTimer,
+    device_memory_stats,
+    trace_context,
+)

@@ -1,0 +1,103 @@
+"""Tracing / profiling utilities (SURVEY §5, tracing row).
+
+The reference logs per-epoch wall clock + ETA and folds peak CPU/GPU
+memory into the epoch metrics (reference: custom_trainer.py:309-316,
+500-503,674-679,759-768).  The TPU equivalents here:
+
+* :class:`StepTimer` — streaming step timings with percentile summary
+  (first-step compile time reported separately — on TPU the first step
+  includes XLA compilation and would poison a mean);
+* :func:`device_memory_stats` — per-device live/peak HBM bytes via the
+  device ``memory_stats()`` API (absent on some backends → {});
+* :func:`trace_context` — a ``jax.profiler`` trace scope producing a
+  TensorBoard-loadable trace directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import numpy as np
+
+
+class StepTimer:
+    """Accumulates per-step wall-clock timings.
+
+    Usage::
+
+        timer = StepTimer()
+        for batch in data:
+            with timer.step():
+                run(batch)
+        metrics.update(timer.summary())
+    """
+
+    def __init__(self) -> None:
+        self._durations: List[float] = []
+
+    @contextlib.contextmanager
+    def step(self) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._durations.append(time.perf_counter() - start)
+
+    def __len__(self) -> int:
+        return len(self._durations)
+
+    def summary(self, prefix: str = "step_") -> Dict[str, float]:
+        """Timing summary; the first (compile-bearing) step is excluded
+        from the steady-state stats and reported as ``first_s``."""
+        if not self._durations:
+            return {}
+        first, rest = self._durations[0], self._durations[1:]
+        out = {
+            f"{prefix}first_s": first,
+            f"{prefix}count": float(len(self._durations)),
+            f"{prefix}total_s": float(np.sum(self._durations)),
+        }
+        if rest:
+            out.update(
+                {
+                    f"{prefix}mean_s": float(np.mean(rest)),
+                    f"{prefix}p50_s": float(np.percentile(rest, 50)),
+                    f"{prefix}p95_s": float(np.percentile(rest, 95)),
+                    f"{prefix}max_s": float(np.max(rest)),
+                }
+            )
+        return out
+
+    def reset(self) -> None:
+        self._durations.clear()
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, float]:
+    """Live/peak memory for one device (the reference folds peak memory
+    into epoch metrics, custom_trainer.py:674-679).  Returns {} when the
+    backend exposes no stats (e.g. CPU)."""
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    out: Dict[str, float] = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = float(stats[key])
+    return out
+
+
+@contextlib.contextmanager
+def trace_context(log_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler`` trace scope; no-op when ``log_dir`` is falsy."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,122 @@
+"""Native (C++) normalizer: build, parity vs the Python pass table,
+fallback contract, and batch throughput sanity.
+
+The Python implementation is the specification (itself pinned against the
+reference MemVul/util.py:39-142 by test_normalize.py); the native library
+must agree byte-for-byte or be disabled by its own self-check.
+"""
+
+import pytest
+
+from memvul_tpu.data.native import (
+    get_native_normalizer,
+    native_available,
+    normalize_batch,
+    _native_one,
+)
+from memvul_tpu.data.normalize import normalize_text
+from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native normalizer failed to build/self-check"
+)
+
+ADVERSARIAL = [
+    "",
+    " ",
+    "CVE-2021-44228 CWE-79 CVE-1-2",
+    "https://cve.mitre.org/data?x=1 http://bugzilla.redhat.com/123",
+    "https://example.com/a.zip https://example.com/index",
+    "[link](a/b/c.md) ![img](http://x.com/i.png) [t](http://y.com)",
+    "``` erro```r``` fine ```",
+    "`` `` ` ` ``````",
+    "nested `outer ```inner``` outer` end",
+    "a@b.com someone_longer@domain.net x@y.cn @mention ",
+    "Main.java:42 NullPointerException(foo) IOError: bad",
+    "/a/b/c d\\e\\f g/h win\\path\\x.txt",
+    "v1.2.3 2021-01-01 1e10 0x1F beta7 1.0.0-beta3",
+    "thisIsCamel ALLCAPS lower.dotted.name call() arr[]",
+    "<html><body> <<>> <a href=\"x\"> <-> < >",
+    "-- --- ---- -",
+    "####title *bold* **x** \\n\\n \\r\\n \\t\\t",
+    "x" * 29, "y" * 30, "z" * 151,
+    "word " * 200,
+    "yaml\nfoo: bar\nbaz: qux",
+    "Traceback (most recent call last):\n  File \"x.py\", line 1",
+    "ünïcode naïve café — em-dash…",
+    "tab\there newline\nhere cr\rhere",
+]
+
+
+def test_parity_on_adversarial_battery():
+    for doc in ADVERSARIAL:
+        lib = get_native_normalizer()
+        native = _native_one(lib, doc)
+        if native is None:
+            continue  # explicit fallback is allowed, silence is not
+        assert native == normalize_text(doc), f"divergence on {doc[:60]!r}"
+
+
+def test_parity_on_synthetic_corpus():
+    reports, _ = generate_corpus(seed=13, num_projects=6, reports_per_project=30)
+    texts = corpus_texts(reports)
+    native_out = normalize_batch(texts)
+    python_out = [normalize_text(t) for t in texts]
+    assert native_out == python_out
+
+
+def test_batch_matches_single_calls():
+    docs = ADVERSARIAL[:10]
+    assert normalize_batch(docs) == [normalize_text(d) for d in docs]
+
+
+def test_force_python_path():
+    docs = ["CVE-2020-1 check"]
+    assert normalize_batch(docs, force_python=True) == [normalize_text(docs[0])]
+
+
+def test_non_ascii_doc_falls_back_natively():
+    """Byte-oriented std::regex disagrees with Python's unicode \\s (e.g.
+    U+00A0), so the library refuses non-ASCII docs and Python answers."""
+    lib = get_native_normalizer()
+    doc = "@user\xa0hello there"
+    assert _native_one(lib, doc) is None
+    assert normalize_batch([doc]) == [normalize_text(doc)]
+
+
+def test_nul_byte_doc_falls_back():
+    doc = "abc\x00hidden error text here"
+    lib = get_native_normalizer()
+    assert _native_one(lib, doc) is None  # would truncate at the NUL
+    assert normalize_batch([doc]) == [normalize_text(doc)]
+
+
+def test_corrupt_library_disables_native(tmp_path, monkeypatch):
+    """A wrong-arch/corrupt .so must disable the native path, not crash."""
+    import memvul_tpu.data.native as native_mod
+
+    bad = tmp_path / "libmemvul_native.so"
+    bad.write_bytes(b"not a shared object")
+    monkeypatch.setattr(native_mod, "_LIB", bad)
+    monkeypatch.setattr(native_mod, "_build_library", lambda: True)
+    assert native_mod._load() is None
+
+
+def test_oversized_doc_falls_back():
+    lib = get_native_normalizer()
+    big = "word " * 300_000  # >1MB → native returns NULL
+    assert _native_one(lib, big) is None
+    # the batch API still returns the correct Python-computed result
+    out = normalize_batch([big, "small CVE-2021-2 doc"])
+    assert out[1] == normalize_text("small CVE-2021-2 doc")
+    assert out[0] == normalize_text(big)
+
+
+def test_preprocess_uses_batch_path():
+    from memvul_tpu.data.corpus import preprocess
+
+    reports, _ = generate_corpus(seed=3, num_projects=2, reports_per_project=10)
+    raw_titles = {r["Issue_Url"]: r["Issue_Title"] for r in reports}
+    clean = preprocess(reports)
+    for rec in clean:
+        assert rec["Issue_Title"] == normalize_text(raw_titles[rec["Issue_Url"]])

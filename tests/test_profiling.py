@@ -1,0 +1,76 @@
+"""Tracing/profiling utilities (SURVEY §5, tracing row)."""
+
+import time
+
+import jax
+
+from memvul_tpu.utils.profiling import StepTimer, device_memory_stats, trace_context
+
+
+def test_step_timer_separates_first_step():
+    timer = StepTimer()
+    with timer.step():
+        time.sleep(0.05)  # the "compile" step
+    for _ in range(5):
+        with timer.step():
+            time.sleep(0.005)
+    s = timer.summary()
+    assert s["step_count"] == 6.0
+    assert s["step_first_s"] > s["step_mean_s"]
+    assert s["step_p95_s"] >= s["step_p50_s"]
+    timer.reset()
+    assert timer.summary() == {}
+
+
+def test_step_timer_single_step():
+    timer = StepTimer()
+    with timer.step():
+        pass
+    s = timer.summary()
+    assert s["step_count"] == 1.0
+    assert "step_mean_s" not in s  # no steady-state stats from one step
+
+
+def test_device_memory_stats_shape():
+    stats = device_memory_stats()
+    # CPU backend may expose nothing; when present the values are floats
+    for v in stats.values():
+        assert isinstance(v, float)
+
+
+def test_trace_context_noop_and_real(tmp_path):
+    with trace_context(None):
+        pass  # no-op path
+    with trace_context(str(tmp_path / "trace")):
+        jax.numpy.ones(4).sum().block_until_ready()
+    assert any((tmp_path / "trace").rglob("*"))
+
+
+def test_trainer_epoch_metrics_include_timings(tmp_path):
+    from memvul_tpu.build import build_model, build_reader, build_tokenizer, init_params
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+    ws = build_workspace(tmp_path / "ws", seed=21)
+    tokenizer = build_tokenizer({"tokenizer_path": ws["paths"]["tokenizer"]})
+    reader = build_reader({
+        "type": "reader_memory", "sample_neg": 1.0,
+        "same_diff_ratio": {"same": 2, "diff": 2},
+        "cve_path": ws["paths"]["cve"], "anchor_path": ws["paths"]["anchors"],
+    })
+    model = build_model(
+        {"type": "model_memory", "encoder": {"preset": "tiny", "vocab_size": 4096},
+         "header_dim": 16}, tokenizer.vocab_size,
+    )
+    trainer = MemoryTrainer(
+        model, init_params(model), tokenizer, reader,
+        train_path=ws["paths"]["train"],
+        config=TrainerConfig(
+            num_epochs=1, batch_size=4, grad_accum=2, max_length=32,
+            steps_per_epoch=2, warmup_steps=2,
+        ),
+    )
+    metrics = trainer.train_epoch()
+    assert metrics["step_count"] == 2.0
+    assert metrics["step_first_s"] > 0
+    assert metrics["num_steps"] == 2
