@@ -34,8 +34,11 @@ def main() -> None:
     from memvul_tpu.models import BertConfig, MemoryModel
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
-    batch_size = int(os.environ.get("BENCH_BATCH", "256"))
-    n_reports = int(os.environ.get("BENCH_REPORTS", "2048"))
+    # batch 1024 ≈ best single-chip throughput at seq 512 (2048 exceeds
+    # HBM: the attention score tensor alone is ~13GB); measured sweep:
+    # 256→708, 512→848, 1024→898 reports/s on v5e
+    batch_size = int(os.environ.get("BENCH_BATCH", "1024"))
+    n_reports = int(os.environ.get("BENCH_REPORTS", "4096"))
     n_anchors = 129  # reference external-memory size (utils.py:347)
 
     ws = build_workspace(

@@ -33,7 +33,12 @@ using std::regex;
 using std::regex_constants::icase;
 using std::string;
 
-constexpr size_t kMaxDocBytes = 1 << 20;  // fall back on >1MB documents
+// libstdc++'s std::regex executor recurses per matched character for
+// quantified alternations (kUrl, kApiCatchall); large documents can
+// overflow the thread stack, which catch(...) cannot intercept.  16KB
+// keeps worst-case recursion far below the 8MB stack; bigger documents
+// fall back to Python (rare in issue-report corpora).
+constexpr size_t kMaxDocBytes = 16 << 10;
 constexpr size_t kMaxApiSpan = 150;       // normalize.py _MAX_API_SPAN
 
 // ---------------------------------------------------------------------------
@@ -41,9 +46,10 @@ constexpr size_t kMaxApiSpan = 150;       // normalize.py _MAX_API_SPAN
 // with re.S becomes [\s\S]; everything else is shared syntax.
 // ---------------------------------------------------------------------------
 
-const regex kCommentLine("<!---.*?-->");  // '.' excludes newline in both
-// Python (no re.S) and ECMAScript — multi-line comments pass through,
-// matching the Python behavior exactly
+// Python's '.' (no re.S) excludes only \n; ECMAScript '.' also excludes
+// \r/ /  — use [^\n] explicitly so comments containing a bare
+// carriage return normalize identically on both paths
+const regex kCommentLine("<!---[^\\n]*?-->");
 
 const regex kErrorish(
     "exception|error|warning|404|can't|can\\s?not|could\\s?not|un[a-z]{3,}",

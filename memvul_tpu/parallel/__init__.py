@@ -1,4 +1,5 @@
 from .mesh import create_mesh, shard_batch, replicate  # noqa: F401
+from . import multihost  # noqa: F401
 from .ring import (  # noqa: F401
     encode_sequence_parallel,
     make_ring_attention,

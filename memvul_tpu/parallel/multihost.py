@@ -1,0 +1,104 @@
+"""Multi-host (multi-process) initialization over DCN.
+
+The reference's distributed backend is torch.distributed/NCCL with
+explicit all_reduce/barrier calls (reference: custom_trainer.py:254-259,
+379-396) — coded but never enabled by any shipped config.  The TPU
+equivalent needs no hand-written collectives at all: after
+``jax.distributed.initialize``, ``jax.devices()`` spans every host's
+chips, a mesh built over them shards arrays across ICI within a slice
+and DCN across slices, and XLA inserts all communication.
+
+Typical multi-host launch (same program on every host)::
+
+    from memvul_tpu.parallel import multihost, create_mesh
+    multihost.initialize()                 # env-driven on TPU pods
+    mesh = create_mesh({"data": -1})       # all global devices
+    ...
+    if multihost.is_primary():             # one writer for checkpoints/logs
+        save(...)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+# env markers that signal a multi-process launch; checked WITHOUT touching
+# jax (any jax.devices()/process_count() call would initialize the XLA
+# backend, after which jax.distributed.initialize refuses to run)
+_ENV_MARKERS = (
+    "MEMVUL_MULTIHOST",
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    require: bool = False,
+) -> bool:
+    """Join the multi-process runtime.  MUST run before any jax
+    computation (backend initialization closes the window).
+
+    The decision to join is made from explicit arguments or environment
+    markers only — never by probing jax, which would itself initialize
+    the backend.  On TPU pods, set ``MEMVUL_MULTIHOST=1`` (or pass
+    ``require=True``) and the TPU runtime supplies coordinator/process
+    details; elsewhere pass them explicitly.  Returns False when nothing
+    signals a multi-process launch.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    explicit = (
+        require
+        or coordinator_address is not None
+        or num_processes is not None
+    )
+    env_opt_in = any(os.environ.get(k) for k in _ENV_MARKERS)
+    if not (explicit or env_opt_in):
+        logger.debug("no multi-process markers — skipping distributed init")
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "multihost: process %d/%d, %d local + %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that should write checkpoints/metrics."""
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This host's contiguous slice of a globally sharded batch — for
+    host-side input pipelines that shard by process (each host feeds its
+    own chips; the mesh handles the rest)."""
+    n = jax.process_count()
+    if global_batch % n != 0:
+        raise ValueError(f"global batch {global_batch} not divisible by {n} hosts")
+    per = global_batch // n
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)

@@ -117,6 +117,10 @@ class TrainerConfig:
     online_resample: bool = True
     # when set, epoch 0 is wrapped in a jax.profiler trace written here
     profile_dir: Optional[str] = None
+    # exponential moving average of params; validation/checkpoint use the
+    # averaged weights (the reference's moving_average support,
+    # custom_trainer.py:437-439,514-516)
+    ema_decay: Optional[float] = None
 
 
 class MemoryTrainer:
@@ -174,6 +178,16 @@ class MemoryTrainer:
         )
         self.metrics_history: List[Dict[str, Any]] = []
         self._train_step = jax.jit(make_train_step(self.model, self.tx))
+        self.ema_params = None
+        if c.ema_decay is not None:
+            decay = float(c.ema_decay)
+            self.ema_params = jax.tree_util.tree_map(jnp.copy, self.params)
+            self._ema_update = jax.jit(
+                lambda ema, p: jax.tree_util.tree_map(
+                    lambda e, x: e * decay + x.astype(e.dtype) * (1.0 - decay),
+                    ema, p,
+                )
+            )
 
     # -- data ----------------------------------------------------------------
 
@@ -248,6 +262,8 @@ class MemoryTrainer:
                 if np.isnan(loss):
                     raise FloatingPointError(f"NaN loss at step {self.step}")
                 losses.append(loss)
+                if self.ema_params is not None:
+                    self.ema_params = self._ema_update(self.ema_params, self.params)
                 preds = np.asarray(logits.argmax(axis=-1)).reshape(-1)
                 labels = np.asarray(stack["label"]).reshape(-1)
                 weights = np.asarray(stack["weight"]).reshape(-1)
@@ -284,7 +300,12 @@ class MemoryTrainer:
                 max_length=c.eval_max_length,
             )
         predictor = self._val_predictor
-        predictor.params = self.params  # current weights, compiled fns reused
+        # validate with the averaged weights when EMA is on — the
+        # reference swaps the moving average in around validation
+        # (custom_trainer.py:514-516)
+        predictor.params = (
+            self.ema_params if self.ema_params is not None else self.params
+        )
         predictor.encode_anchors(self.reader.read_anchors(self.anchor_path))
         out_dir = (
             Path(c.serialization_dir)
@@ -341,7 +362,7 @@ class MemoryTrainer:
     # -- state ----------------------------------------------------------------
 
     def _state_dict(self) -> Dict[str, Any]:
-        return {
+        state = {
             "params": jax.device_get(self.params),
             "opt_state": jax.device_get(self.opt_state),
             "rng": jax.device_get(self.rng),
@@ -351,6 +372,9 @@ class MemoryTrainer:
                 "tracker": self.tracker.state_dict(),
             },
         }
+        if self.ema_params is not None:
+            state["ema_params"] = jax.device_get(self.ema_params)
+        return state
 
     def maybe_restore(self) -> bool:
         if self.checkpointer is None:
@@ -362,6 +386,8 @@ class MemoryTrainer:
         self.params = state["params"]
         self.opt_state = state["opt_state"]
         self.rng = jnp.asarray(state["rng"])
+        if self.ema_params is not None and "ema_params" in state:
+            self.ema_params = state["ema_params"]
         meta = state["meta"]
         self.step = int(meta["step"])
         self.epoch = int(meta["epoch"]) + 1  # resume after the saved epoch
@@ -385,8 +411,12 @@ class MemoryTrainer:
 
     def best_params(self):
         """Reload the best-by-validation params (reference:
-        custom_trainer.py:779-784)."""
+        custom_trainer.py:779-784) — the EMA weights when averaging is on,
+        since those are what validation selected."""
+        live = self.ema_params if self.ema_params is not None else self.params
         if self.checkpointer is None:
-            return self.params
+            return live
         state = self.checkpointer.restore_best(self._state_dict())
-        return state["params"] if state is not None else self.params
+        if state is None:
+            return live
+        return state.get("ema_params") or state["params"]

@@ -1,0 +1,94 @@
+"""EMA moving-average training + multi-host helpers.
+
+Reference: the trainer's moving_average support
+(custom_trainer.py:437-439,514-516) and the distributed backend
+(custom_trainer.py:254-259) — here a jax.distributed wrapper.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from memvul_tpu.build import build_model, build_reader, build_tokenizer, init_params
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.parallel import multihost
+from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("ema"), seed=31)
+
+
+def make_trainer(ws, **cfg_kw):
+    tokenizer = build_tokenizer({"tokenizer_path": ws["paths"]["tokenizer"]})
+    reader = build_reader({
+        "type": "reader_memory", "sample_neg": 1.0,
+        "same_diff_ratio": {"same": 2, "diff": 2},
+        "cve_path": ws["paths"]["cve"], "anchor_path": ws["paths"]["anchors"],
+    })
+    model = build_model(
+        {"type": "model_memory", "encoder": {"preset": "tiny", "vocab_size": 4096},
+         "header_dim": 16}, tokenizer.vocab_size,
+    )
+    cfg = dict(num_epochs=1, batch_size=4, grad_accum=2, max_length=32,
+               steps_per_epoch=3, warmup_steps=2)
+    cfg.update(cfg_kw)
+    return MemoryTrainer(
+        model, init_params(model), tokenizer, reader,
+        train_path=ws["paths"]["train"], config=TrainerConfig(**cfg),
+    )
+
+
+def _leaf(params):
+    return np.asarray(params["params"]["pair_kernel"], np.float32)
+
+
+def test_ema_tracks_behind_live_params(ws):
+    trainer = make_trainer(ws, ema_decay=0.9)
+    init = _leaf(trainer.params).copy()
+    trainer.train_epoch()
+    live, ema = _leaf(trainer.params), _leaf(trainer.ema_params)
+    # live params moved; EMA moved less (it lags the trajectory)
+    assert np.abs(live - init).max() > 0
+    assert 0 < np.abs(ema - init).max() < np.abs(live - init).max()
+    # best_params surfaces the EMA weights
+    np.testing.assert_array_equal(_leaf(trainer.best_params()), ema)
+
+
+def test_ema_disabled_by_default(ws):
+    trainer = make_trainer(ws)
+    assert trainer.ema_params is None
+    trainer.train_epoch()
+    np.testing.assert_array_equal(_leaf(trainer.best_params()), _leaf(trainer.params))
+
+
+def test_ema_checkpoint_roundtrip(ws, tmp_path):
+    trainer = make_trainer(
+        ws, ema_decay=0.9, serialization_dir=str(tmp_path / "ser"), num_epochs=1
+    )
+    trainer.train()
+    ema = _leaf(trainer.ema_params)
+    resumed = make_trainer(
+        ws, ema_decay=0.9, serialization_dir=str(tmp_path / "ser"), num_epochs=1
+    )
+    assert resumed.maybe_restore()
+    np.testing.assert_array_equal(_leaf(resumed.ema_params), ema)
+
+
+def test_multihost_single_process_noop():
+    assert multihost.initialize() is False  # nothing to join
+    assert multihost.is_primary()
+    assert multihost.process_count() == 1
+
+
+def test_local_batch_slice(monkeypatch):
+    s = multihost.local_batch_slice(64)
+    assert (s.start, s.stop) == (0, 64)
+    # simulate a 4-host run: process 1 owns rows [16, 32)
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    s = multihost.local_batch_slice(64)
+    assert (s.start, s.stop) == (16, 32)
+    with pytest.raises(ValueError):
+        multihost.local_batch_slice(7)
