@@ -8,7 +8,10 @@ an archive is a tar.gz holding
 
 * ``config.json``     — the fully-resolved training config,
 * ``weights.msgpack`` — flax-serialized parameters,
-* ``tokenizer.json``  — the tokenizer state (when file-backed).
+* ``tokenizer.json``  — the tokenizer state (when file-backed), OR
+* ``vocab.txt``       — a bert-style wordpiece vocabulary (when the
+  tokenizer was built from one; the name tells load_archive which
+  constructor path to use).
 
 ``load_archive(path, overrides)`` deep-merges overrides onto the stored
 config (the reference's with_fallback semantics) and reconstructs the
@@ -54,8 +57,16 @@ def save_archive(
         (tmp / "weights.msgpack").write_bytes(serialization.to_bytes(params))
         members = ["config.json", "weights.msgpack"]
         if tokenizer_file is not None and Path(tokenizer_file).exists():
-            (tmp / "tokenizer.json").write_text(Path(tokenizer_file).read_text())
-            members.append("tokenizer.json")
+            # a bert-style vocab.txt keeps its name so load_archive knows
+            # which constructor path to use; everything else is a
+            # tokenizers-library (or word-vocab) JSON file
+            arc = (
+                "vocab.txt"
+                if str(tokenizer_file).endswith(".txt")
+                else "tokenizer.json"
+            )
+            (tmp / arc).write_text(Path(tokenizer_file).read_text())
+            members.append(arc)
         with tarfile.open(out_path, "w:gz") as tar:
             for name in members:
                 tar.add(tmp / name, arcname=name)
@@ -82,13 +93,21 @@ def load_archive(
             if isinstance(overrides, str):
                 overrides = json.loads(overrides)
             config = merge_overrides(config, overrides)
+        vocab_file = tmp / "vocab.txt"
         tok_file = tmp / "tokenizer.json"
         tok_cfg = dict(config.get("tokenizer") or {})
-        if tok_file.exists():
+        if vocab_file.exists():
+            # archived bert-style vocab — must win over any path the stored
+            # config happens to mention (which may not exist on this host)
+            tok_cfg.pop("tokenizer_path", None)
+            tok_cfg["vocab_path"] = str(vocab_file)
+        elif tok_file.exists():
             # word-level tokenizers store a plain vocab dict, wordpiece a
             # full tokenizers-library file — different constructor params
             key = "vocab_path" if tok_cfg.get("type") == "word" else "tokenizer_path"
             tok_cfg[key] = str(tok_file)
+            if key == "tokenizer_path":
+                tok_cfg.pop("vocab_path", None)
         tokenizer = build_tokenizer(tok_cfg)
         model = build_model(config.get("model") or {}, tokenizer.vocab_size)
         params = serialization.msgpack_restore(

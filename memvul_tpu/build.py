@@ -131,8 +131,14 @@ def save_encoder_checkpoint(encoder_params, out_dir: Union[str, Path]) -> Path:
 
 
 def _tokenizer_file(tok_cfg: Optional[Dict[str, Any]]) -> Optional[str]:
+    """The file to embed in the archive — MUST mirror the selection
+    precedence of ``WordPieceTokenizer.__init__`` (an existing vocab.txt
+    wins) so the archived tokenizer is the one training actually used."""
     tok_cfg = tok_cfg or {}
-    return tok_cfg.get("tokenizer_path") or tok_cfg.get("vocab_path")
+    vocab = tok_cfg.get("vocab_path")
+    if vocab and Path(vocab).exists():
+        return vocab
+    return tok_cfg.get("tokenizer_path") or vocab
 
 
 def train_from_config(

@@ -15,6 +15,7 @@ number of distinct shapes reaching XLA stays small and compile caches hit.
 from __future__ import annotations
 
 import json
+import logging
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Union
 
@@ -86,7 +87,21 @@ class WordPieceTokenizer(TextTokenizer):
     ) -> None:
         from tokenizers import Tokenizer as _FastTokenizer
 
-        if tokenizer_path is not None:
+        # A real bert-style ``vocab.txt`` (e.g. bert-base-uncased's — the
+        # reference's vocabulary, MemVul/config_memory.json:16-20) wins when
+        # it exists on disk; otherwise fall back to a trained tokenizer.json.
+        # The vocab.txt loading path is id-level parity-tested against HF's
+        # BertTokenizer (tests/test_tokenizer_hf_parity.py), so dropping the
+        # genuine vocab file in gives reference tokenization exactly.
+        if vocab_path is not None and Path(vocab_path).exists():
+            if tokenizer_path is not None:
+                logging.getLogger(__name__).info(
+                    "tokenizer: using bert vocab %s (tokenizer file %s ignored)",
+                    vocab_path,
+                    tokenizer_path,
+                )
+            self._tok = _bert_tokenizer_from_vocab(str(vocab_path), lowercase)
+        elif tokenizer_path is not None:
             self._tok = _FastTokenizer.from_file(str(tokenizer_path))
         elif vocab_path is not None:
             self._tok = _bert_tokenizer_from_vocab(str(vocab_path), lowercase)

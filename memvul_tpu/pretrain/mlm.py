@@ -140,6 +140,22 @@ def transplant_encoder(classifier_params, encoder_subtree) -> Dict:
     (custom_PTM_embedder.py:95-99)."""
     out = dict(jax.device_get(classifier_params))
     out["params"] = dict(out["params"])
+    # guard against a tokenizer/vocab swap between pretrain and fine-tune:
+    # a mismatched embedding table would silently clamp out-of-range ids
+    # under XLA and produce garbage representations
+    def _embed_rows(tree):
+        emb = tree.get("embeddings", {}).get("word_embeddings", {})
+        table = emb.get("embedding")
+        return None if table is None else table.shape[0]
+
+    want = _embed_rows(out["params"].get("bert", {}))
+    got = _embed_rows(encoder_subtree)
+    if want is not None and got is not None and want != got:
+        raise ValueError(
+            f"pretrained encoder vocab size {got} != classifier vocab size "
+            f"{want}; the tokenizer changed between pretraining and "
+            "fine-tuning (did data/vocab.txt appear after the MLM run?)"
+        )
     out["params"]["bert"] = encoder_subtree
     return out
 

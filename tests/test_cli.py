@@ -125,6 +125,29 @@ def test_archive_roundtrip_with_overrides(ws, tmp_path):
     assert arch.tokenizer.vocab_size == ws["tokenizer"].vocab_size
 
 
+def test_archive_roundtrip_with_bert_vocab_txt(tmp_path):
+    """An archive built from a bert-style ``vocab.txt`` stays self-contained:
+    the vocab file keeps its name in the tar and wins over any (possibly
+    nonexistent) path mentioned in the stored config."""
+    words = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "hello", "world", "##s"]
+    vocab_path = tmp_path / "vocab.txt"
+    vocab_path.write_text("\n".join(words) + "\n")
+    model_cfg = {"type": "model_memory", "encoder": {"preset": "tiny", "vocab_size": len(words)}, "header_dim": 32}
+    config = {
+        # deliberately points at a path that will NOT exist at load time
+        "tokenizer": {"type": "wordpiece", "vocab_path": "data/vocab.txt"},
+        "model": model_cfg,
+    }
+    model = build_model(model_cfg, len(words))
+    params = init_params(model, seed=0)
+    path = save_archive(
+        tmp_path / "model.tar.gz", config, params, tokenizer_file=vocab_path
+    )
+    arch = load_archive(path)
+    assert arch.tokenizer.vocab_size == len(words)
+    assert arch.tokenizer.encode("hello worlds") == [2, 5, 6, 7, 3]
+
+
 # -- end-to-end CLI ------------------------------------------------------------
 
 def test_cli_train_then_evaluate_memory(ws, tmp_path):

@@ -1,0 +1,41 @@
+"""Golden-output oracle for the text normalizer.
+
+``tests/golden/normalizer_golden.json`` was produced by executing the
+REFERENCE normalizer (reference: MemVul/util.py:39-142,
+``replace_tokens_simple``) over a 219-document adversarial battery via
+``tools/gen_normalizer_golden.py``.  This test asserts byte-equality of
+``normalize_text`` against those reference outputs — the root of the
+F1-parity chain: identical tag streams in ⇒ identical tokens in.
+
+There are currently ZERO intentional divergences; any future divergence
+must be added to ``KNOWN_DIVERGENCES`` with a written justification.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from memvul_tpu.data.normalize import normalize_text
+
+GOLDEN = Path(__file__).parent / "golden" / "normalizer_golden.json"
+
+# input -> reason strings for any documented, intentional divergence.
+KNOWN_DIVERGENCES: dict = {}
+
+
+def _cases():
+    return json.loads(GOLDEN.read_text())
+
+
+def test_battery_is_large_enough():
+    assert len(_cases()) >= 200
+
+
+@pytest.mark.parametrize(
+    "case", _cases(), ids=lambda c: repr(c["input"][:40])
+)
+def test_normalize_matches_reference_golden(case):
+    if case["input"] in KNOWN_DIVERGENCES:
+        pytest.skip(KNOWN_DIVERGENCES[case["input"]])
+    assert normalize_text(case["input"]) == case["expected"]

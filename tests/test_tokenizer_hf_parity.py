@@ -1,0 +1,123 @@
+"""Tokenizer parity vs HuggingFace's reference ``BertTokenizer``.
+
+The reference tokenizes with bert-base-uncased wordpieces through AllenNLP's
+``PretrainedTransformerTokenizer`` (reference: MemVul/config_memory.json:16-20),
+which delegates to HF.  This environment has no network egress, so the real
+30,522-entry ``vocab.txt`` cannot be vendored; what CAN be proven offline is
+that our ``vocab.txt`` loading path (``tokenizer.py::_bert_tokenizer_from_vocab``)
+implements the *identical algorithm*: given the same vocab file, our encoder
+produces the same id sequences as ``transformers.BertTokenizer`` — basic
+tokenization (lowercase, accent-strip, CJK spacing, punctuation splits),
+greedy wordpiece with ``##`` continuations and the 100-char [UNK] cutoff,
+[CLS]/[SEP] framing, and truncation.  With algorithm parity proven, pointing
+``vocab_path`` at a user-supplied bert-base-uncased ``vocab.txt`` yields
+id-level parity with the reference pipeline by construction.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+
+GOLDEN = Path(__file__).parent / "golden" / "normalizer_golden.json"
+
+EDGE_TEXTS = [
+    "",
+    " ",
+    "hello world",
+    "The Quick BROWN fox!",
+    "émigré naïve café über",
+    "中文字符 mixed english",
+    "日本語とカタカナ",
+    "punctuation,everywhere.even;inside:words",
+    "x" * 99,
+    "x" * 100,  # wordpiece max_input_chars_per_word boundary
+    "x" * 101,
+    "APITAG CODETAG ERRORTAG FILETAG URLTAG CVETAG",
+    "EMAILTAG MENTIONTAG PATHTAG NUMBERTAG",
+    "weird space chars here",
+    "control\x00chars\x1fstripped",
+    "emoji 🙂 inside",
+    "a-b-c hyphens",
+    "'quoted' \"double\" (parens) [brackets]",
+    "123 456.789 0x1A",
+    "mixedCASE and ALLCAPS and lower",
+    "\t\n\r whitespace soup \t",
+    "ünïcödé àccénts ēvērywhere",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    """Train a realistic wordpiece vocab from the golden corpus and dump it
+    in bert ``vocab.txt`` format (one token per line, line number = id)."""
+    corpus = [c["expected"] for c in json.loads(GOLDEN.read_text())]
+    corpus += [t for t in EDGE_TEXTS if t.strip()]
+    tok = WordPieceTokenizer.train_from_corpus(corpus, vocab_size=2048)
+    vocab = tok._tok.get_vocab()
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    ordered = sorted(vocab.items(), key=lambda kv: kv[1])
+    assert [i for _, i in ordered] == list(range(len(ordered)))
+    path.write_text("\n".join(w for w, _ in ordered) + "\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def pair(vocab_file):
+    hf = transformers.BertTokenizer(vocab_file, do_lower_case=True)
+    ours = WordPieceTokenizer(vocab_path=vocab_file)
+    return hf, ours
+
+
+def test_golden_corpus_id_parity(pair):
+    """Every normalized golden document tokenizes to identical ids."""
+    hf, ours = pair
+    for case in json.loads(GOLDEN.read_text()):
+        text = case["expected"]
+        assert ours.encode(text) == hf.encode(text), repr(text[:60])
+
+
+@pytest.mark.parametrize("text", EDGE_TEXTS, ids=lambda t: repr(t[:24]))
+def test_edge_case_id_parity(pair, text):
+    hf, ours = pair
+    assert ours.encode(text) == hf.encode(text)
+
+
+@pytest.mark.parametrize("max_length", [8, 16, 256, 512])
+def test_truncation_parity(pair, max_length):
+    """Truncation keeps [CLS] ... [SEP] framing exactly like HF
+    (train length 256 / eval length 512; reference:
+    MemVul/config_memory.json:19, test_config_memory.json:9)."""
+    hf, ours = pair
+    for case in json.loads(GOLDEN.read_text())[::7]:
+        text = case["expected"]
+        expected = hf.encode(text, truncation=True, max_length=max_length)
+        assert ours.encode(text, max_length=max_length) == expected
+
+
+def test_special_token_ids_match(pair):
+    hf, ours = pair
+    assert ours.cls_id == hf.cls_token_id
+    assert ours.sep_id == hf.sep_token_id
+    assert ours.pad_id == hf.pad_token_id
+    assert ours.mask_id == hf.mask_token_id
+
+
+def test_batch_shapes_and_mask(pair):
+    hf, ours = pair
+    texts = ["hello world", "a much longer sentence with many more words here"]
+    batch = ours.encode_batch(texts, max_length=32, pad_to=32)
+    assert batch["input_ids"].shape == (2, 32)
+    assert batch["attention_mask"].shape == (2, 32)
+    assert batch["token_type_ids"].shape == (2, 32)
+    for row, text in zip(range(2), texts):
+        ids = hf.encode(text, truncation=True, max_length=32)
+        n = len(ids)
+        assert batch["input_ids"][row, :n].tolist() == ids
+        assert batch["attention_mask"][row, :n].tolist() == [1] * n
+        assert batch["attention_mask"][row, n:].sum() == 0
+        assert (batch["input_ids"][row, n:] == ours.pad_id).all()

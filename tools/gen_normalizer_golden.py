@@ -1,0 +1,359 @@
+"""Generate golden outputs for the text normalizer from the REFERENCE code.
+
+Loads ``replace_tokens_simple`` (reference: MemVul/util.py:39-142) plus the
+module-level regex constants it closes over, straight out of the reference
+source file via AST extraction, and executes it over an adversarial battery
+of documents.  The resulting input/output pairs are committed to
+``tests/golden/normalizer_golden.json`` and asserted byte-equal against
+``memvul_tpu.data.normalize.normalize_text`` by
+``tests/test_normalizer_golden.py``.
+
+This script needs ``/root/reference`` present; the committed JSON does not.
+Run:  python tools/gen_normalizer_golden.py [path/to/reference/MemVul/util.py]
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_REF = Path("/root/reference/MemVul/util.py")
+OUT = REPO / "tests" / "golden" / "normalizer_golden.json"
+
+# Names the reference function actually uses (module-level regex constants).
+_WANTED_ASSIGNS = {
+    "ERROR_PATTERN",
+    "API_PATTERN",
+    "WORD_PATTERN",
+    "WORD_PATTERN_1",
+    "NUM_PATTERN",
+    "PATH_PATTERN",
+    "TAG_PATTERN",
+    "CODE_PATTERN",
+    "DOC_PATTERN_URL",
+    "DOC_PATTERN_CODE",
+    "ISSUE_PATTERN",
+}
+
+
+def load_reference_normalizer(util_path: Path):
+    """Extract + exec only the constants and function we need (the reference
+    module itself imports torch/allennlp/matplotlib which may be absent)."""
+    tree = ast.parse(util_path.read_text())
+    keep: list[ast.stmt] = []
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id in _WANTED_ASSIGNS for t in node.targets
+        ):
+            keep.append(node)
+        elif isinstance(node, ast.FunctionDef) and node.name == "replace_tokens_simple":
+            keep.append(node)
+    module = ast.Module(body=keep, type_ignores=[])
+    namespace = {"re": re, "print": print}
+    exec(compile(module, str(util_path), "exec"), namespace)
+    return namespace["replace_tokens_simple"]
+
+
+def battery() -> list[str]:
+    """~200 adversarial documents exercising every normalizer pass."""
+    docs: list[str] = []
+
+    # --- triple-backtick code fences -------------------------------------
+    docs += [
+        "``````",
+        "before `````` after",
+        "```Exception in thread main```",
+        "```a warning occurred```",
+        "```error: segfault at 0x0```",
+        "```404 not found```",
+        "```can't open file```",
+        "```can not open file```",
+        "```cannot open file```",
+        "```could not resolve host```",
+        "```couldnot resolve```",
+        "```unresolved symbol```",  # un[a-z]{3,}
+        "```uncommon words here```",
+        "```just some plain prose words```",
+        "```yaml\nkey: value\n```",
+        "```words, with. punctuation?```",
+        "```single_token```",
+        "``` spaced_token ```",
+        "```x = y + z```",
+        "```def f(a, b):\n    return a + b\n```",
+        "```" + "x" * 200 + "```",
+        "```" + "word " * 40 + "```",
+        "```int main() { return 0; } // short code```",
+        "```first``` middle ```second```",
+        "```same``` and again ```same```",
+        "text with ```nested `inline` code``` end",
+        "```\nmultiline\ncode block\n```",
+        "```multi\nline prose\nwords```",
+    ]
+
+    # --- inline backtick spans -------------------------------------------
+    docs += [
+        "``",
+        "empty `` span",
+        "an `error` inline",
+        "a `warning` inline",
+        "some `plain words here` inline",
+        "an `identifier` inline",
+        "call `foo.bar()` here",
+        "a `x=1;y=2` snippet",
+        "`" + "z" * 180 + "`",
+        "`a` `b` `c`",
+        "repeat `tok` then `tok` again",
+        "`404`",
+        "`yaml stuff`",
+        "mix ```fence``` and `inline` here",
+    ]
+
+    # --- markdown links / images -----------------------------------------
+    docs += [
+        "[readme](docs)",
+        "[click here](http://example.com)",
+        "[file.txt](http://host/path)",
+        "[text](archive.zip)",
+        "![img](screenshot.png)",
+        "![alt text](http://imgur.com/abc)",
+        "[a.b](c.d) twice [e](f)",
+        "[multi\nline](target)",
+        "[x](y) [x](y)",
+        "[v1.2.3](release)",
+        "[link](http://a/b.c)",
+    ]
+
+    # --- html-ish angle brackets -----------------------------------------
+    docs += [
+        "<div><span>>",
+        "a <<>> b",
+        "<a href=x>",
+        "<!DOCTYPE html>",
+        "<br/>",
+        "<tag with=attr>",
+        "<%= erb %>",
+        "<$dollar>",
+        "text <semi;colon> text",
+        "<plain>",
+        "<x><y>",
+    ]
+
+    # --- URLs -------------------------------------------------------------
+    docs += [
+        "see https://cve.mitre.org/cgi-bin/cvename.cgi?name=CVE-2021-1234",
+        "see https://cwe.mitre.org/data/definitions/79.html",
+        "https://bugzilla.redhat.com/show_bug.cgi?id=123",
+        "https://bugs.launchpad.net/bugs/1",
+        "http://example.com/file.txt",
+        "http://example.com/page",
+        "https://github.com/owner/repo/issues/42",
+        "two urls http://a.com/x.py and https://b.org/y",
+        "http://host/archive.tar.gz trailing",
+        "url with anchor https://docs.site/guide#section",
+        "percent http://h/%20%41 done",
+        "https://example.com.",
+    ]
+
+    # --- escapes / emphasis / headers ------------------------------------
+    docs += [
+        "line one\\r\\nline two",
+        "a\\n\\nb",
+        "a\\r\\rb",
+        "a\\t\\tb",
+        'quoted \\" text',
+        "quoted \\' text",
+        "**bold** and *italic* and ***both***",
+        "# h1\n## h2\n### h3",
+        "#hashtag",
+        "a - b -- c --- d",
+        "\\r alone \\n alone \\t alone",
+        "real\ttab and\nnewline and\rcarriage",
+    ]
+
+    # --- CVE / CWE leak guard --------------------------------------------
+    docs += [
+        "CVE-2021-44228 is log4shell",
+        "multiple CVE-2020-1 CVE-2020-2 CVE-2020-33333",
+        "CWE-79 cross-site scripting",
+        "CWE-1000 view",
+        "cve-2021-1234 lowercase stays",
+        "CVE-19-1 short",
+        "prefix-CVE-2021-9999-suffix",
+        "CWE-89 and CVE-2019-0001 together",
+    ]
+
+    # --- emails / mentions ------------------------------------------------
+    docs += [
+        "mail me at user@example.com please",
+        "user_name@host.net done",
+        "a@b.cn x",
+        "@alice please review",
+        "@bob, thanks",
+        "@carol. done",
+        "cc @dave and @erin here",
+        "@under_score fine",
+        "@with-dash fine",
+        "@trailing",
+        "email@toolongdomainpart.com here",
+        "two mails a@b.com c@d.net end",
+    ]
+
+    # --- error tokens -----------------------------------------------------
+    docs += [
+        "NullPointerException was thrown",
+        "got IOError: bad stuff",
+        "java.lang.OutOfMemoryError!",
+        "an Error occurred",
+        "HTTP 404 page",
+        "stacktrace FooError(bar) deep",
+        "MyException",
+        "errors are fine",
+        "Exception",
+        "Exception  double space",
+        "end with Exception",
+    ]
+
+    # --- paths ------------------------------------------------------------
+    docs += [
+        "open /usr/local/bin/tool now",
+        "C:\\Users\\name\\file",
+        "relative/path/to/thing",
+        "a/b",
+        "deep/er/path/here and also /etc/passwd/x",
+        "(paren/inside/path)",
+        "src/main/java/com/example/App",
+        "one/two/",
+        "~/dot/config/file",
+    ]
+
+    # --- file extensions --------------------------------------------------
+    docs += [
+        "see config.xml here",
+        "see data.csv, then",
+        "see archive.zip. done",
+        "run script.sh now",
+        "logo.png image",
+        "notes.md file",
+        "app.js code",
+        "conf.yml and conf.yaml both",
+        "query.sql page.html page.jsp page.php",
+        "style.scss module.ts photo.jpg anim.gif pic.bmp",
+        "doc.pdf report",
+        "weird.PROD file",
+        "upper.XML too",
+        "file.txt? question",
+        "noextension here",
+        "a.exe b.jar c.sbt d.ml",
+    ]
+
+    # --- long tokens / camelCase / calls / dotted / numbers ---------------
+    docs += [
+        "x" * 35 + " long token",
+        "supercalifragilisticexpialidocious29chars",
+        "camelCase identifier",
+        "PascalCase identifier",
+        "getValue() call",
+        "arr[] decl",
+        "foo.bar().baz chained",
+        "module.function_name here",
+        "a.b.c.d.e dotted",
+        "version 1.2.3 here",
+        "v2.0 release",
+        "beta3 build",
+        "1.0.0-beta2 tag",
+        "42 plain number",
+        "2021 year",
+        "x86_64 arch",
+        "utf-8 encoding",
+        "3rd place",
+        "top-10 list",
+        "UPPERCASE WORD",
+        "MiXeD cAsE",
+        "ALLCAPS",
+        "Words In Title Case",
+        "lowercase words only",
+    ]
+
+    # --- comments / misc --------------------------------------------------
+    docs += [
+        "<!--- hidden comment ---> visible",
+        "<!--- one ---> mid <!--- two ---> end",
+        "",
+        " ",
+        "   multiple   spaces   ",
+        "unicode ✓ check émigré naïve",
+        "中文字符 mixed English",
+        "tab\tseparated\tvalues",
+        "trailing newline\n",
+        "\n\nleading newlines",
+        "a,b;c.d:e",
+        "semicolon; separated",
+        "quoted \"double\" and 'single'",
+        "parens (like this) and [brackets]",
+        "curly {braces} here",
+        "percent 50% done",
+        "dollar $var here",
+        "caret ^top and tilde ~home",
+        "pipe | separated | values",
+        "plus + minus",
+        "equals = sign",
+        "question? mark",
+        "exclamation! point",
+    ]
+
+    # --- compound / interaction cases ------------------------------------
+    docs += [
+        "Bug in `parser.py` at /usr/lib/python/site.py line 42: "
+        "NullPointerException, see CVE-2021-1234 and "
+        "https://cve.mitre.org/detail or contact admin@corp.com "
+        "or ping @maintainer thanks",
+        "# Security Report\n\n**Severity**: high\n\n"
+        "```\nTraceback (most recent call last):\n  error at line 1\n```\n\n"
+        "Affects versions 1.0-2.3, see [advisory](https://github.com/x/y.md)",
+        "```same text``` outside same text ```same text```",
+        "`dup` and dup outside",
+        "APITAG already present CODETAG too",
+        "ERRORTAG pre-existing tag",
+        "overlap `code with https://url.com inside`",
+        "fence with link ```[text](http://a.b)```",
+        "email inside path /home/user@host.com/file/x",
+        "CVE-2020-1 inside `CVE-2020-2` code",
+        "a#b#c hashes mid-token",
+        "star*mid*token",
+        "dash-separated-words here",
+        "@mention-at-end",
+        "trailing at-sign @ alone",
+        "http://plain URL then words",
+        "\\\" escaped quote then `code`",
+        "[ref](http://bugzilla.mozilla.org/1) mixed link",
+        "(1) numbered list (2) items",
+        "50,000 with comma",
+        "3.14159 pi approximation",
+        "0x1A hex value",
+        "IPv4 192.168.0.1 address",
+        "port :8080 number",
+        "time 12:34:56 stamp",
+        "date 2021-01-02 iso",
+        "range 1..10 dots",
+        "semver >=1.2.3 constraint",
+    ]
+
+    assert len(docs) >= 200, len(docs)
+    return docs
+
+
+def main() -> None:
+    ref_path = Path(sys.argv[1]) if len(sys.argv) > 1 else DEFAULT_REF
+    fn = load_reference_normalizer(ref_path)
+    cases = [{"input": doc, "expected": fn(doc)} for doc in battery()]
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(cases, indent=1, ensure_ascii=False) + "\n")
+    print(f"wrote {len(cases)} golden cases -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
