@@ -1,108 +1,9 @@
-"""Benchmark: Siamese anchor-bank scoring throughput on TPU.
+"""Repo-root benchmark shim — the implementation lives in the package
+(``memvul_tpu/bench.py``) so installed copies and the CLI share it."""
 
-Measures the north-star workload (SURVEY.md §6): stream issue reports
-through the full inference path — BERT-base encode (bf16), anchor-bank
-match against 129 anchors, per-anchor softmax + best-anchor reduce —
-exactly what `predict_memory` does over the 1.2M-report corpus.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-
-Baseline: the reference repo publishes no throughput number (BASELINE.md).
-The GTX-3090 estimate used here: ~71 TFLOP/s dense fp16 tensor peak at
-~30% achieved MFU for PyTorch-1.8 BERT-base inference ≈ 21 TFLOP/s
-effective; one report at eval length 512 costs ≈ 2·110e6·512 ≈ 1.13e11
-FLOP → ≈ 190 reports/s. We use 190.0; vs_baseline = measured / 190.
-"""
-
-import json
-import os
-import sys
-import tempfile
-import time
-
-BASELINE_RPS_512 = 190.0  # estimated GTX-3090 throughput at seq_len 512 (above)
-
-
-def main() -> None:
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-
-    from memvul_tpu.data.synthetic import build_workspace
-    from memvul_tpu.data.readers import MemoryReader
-    from memvul_tpu.evaluate.predict_memory import SiamesePredictor
-    from memvul_tpu.models import BertConfig, MemoryModel
-
-    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
-    # batch 1024 ≈ best single-chip throughput at seq 512 (2048 exceeds
-    # HBM: the attention score tensor alone is ~13GB); measured sweep:
-    # 256→708, 512→848, 1024→898 reports/s on v5e
-    batch_size = int(os.environ.get("BENCH_BATCH", "1024"))
-    n_reports = int(os.environ.get("BENCH_REPORTS", "4096"))
-    n_anchors = 129  # reference external-memory size (utils.py:347)
-
-    ws = build_workspace(
-        tempfile.mkdtemp(),
-        seed=0,
-        num_projects=8,
-        reports_per_project=max(4, n_reports // 8),
-    )
-    cfg = BertConfig.base(
-        vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
-    )
-    model = MemoryModel(cfg)
-    dummy = {
-        "input_ids": np.zeros((2, 8), np.int32),
-        "attention_mask": np.ones((2, 8), np.int32),
-    }
-    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
-
-    predictor = SiamesePredictor(
-        model, params, ws["tokenizer"], batch_size=batch_size, max_length=seq_len
-    )
-    # 129-anchor bank from synthetic anchor texts (cycled to reference size)
-    base_anchors = list(ws["anchors"].items())
-    instances = []
-    for i in range(n_anchors):
-        cat, text = base_anchors[i % len(base_anchors)]
-        instances.append(
-            {"text1": text, "meta": {"label": f"{cat}#{i}", "type": "golden"}}
-        )
-    predictor.encode_anchors(instances)
-
-    reader = MemoryReader(
-        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
-    )
-    test_instances = list(reader.read(ws["paths"]["test"], split="test"))
-    while len(test_instances) < n_reports:
-        test_instances = test_instances + test_instances
-    test_instances = test_instances[:n_reports]
-
-    def run_pass():
-        total = 0
-        start = time.perf_counter()
-        for probs, metas in predictor.score_instances(iter(test_instances)):
-            total += len(metas)
-        return total, time.perf_counter() - start
-
-    run_pass()  # warmup: compile + tokenizer cache fill
-    total, elapsed = run_pass()
-    rps = total / elapsed
-
-    # the baseline estimate is FLOP-derived, so scale it to the actual
-    # sequence length when BENCH_SEQ_LEN overrides the 512 default
-    baseline = BASELINE_RPS_512 * (512.0 / seq_len)
-    print(
-        json.dumps(
-            {
-                "metric": "siamese_scoring_throughput",
-                "value": round(rps, 1),
-                "unit": "reports/sec",
-                "vs_baseline": round(rps / baseline, 2),
-            }
-        )
-    )
-
+from memvul_tpu.bench import main
 
 if __name__ == "__main__":
+    import sys
+
     sys.exit(main())

@@ -158,10 +158,9 @@ def cmd_build_data(args) -> int:
 
 
 def cmd_bench(args) -> int:
-    import runpy
+    from .bench import main as bench_main
 
-    runpy.run_path(str(Path(__file__).resolve().parent.parent / "bench.py"),
-                   run_name="__main__")
+    bench_main()
     return 0
 
 

@@ -247,6 +247,12 @@ def evaluate_from_archive(
     eval_cfg = arch.config.get("evaluation") or {}
     batch_size = int(eval_cfg.get("batch_size", 512))
     max_length = int(eval_cfg.get("max_length", 512))
+    buckets = eval_cfg.get("buckets")
+    if buckets is not None:
+        buckets = [int(b) for b in buckets]
+    tokens_per_batch = eval_cfg.get("tokens_per_batch")
+    if tokens_per_batch is not None:
+        tokens_per_batch = int(tokens_per_batch)
 
     out_results = out_dir / f"{name}_result.json"
     out_metrics = out_dir / f"{name}_metric_all.json"
@@ -271,6 +277,8 @@ def evaluate_from_archive(
             use_mesh=use_mesh,
             batch_size=batch_size,
             max_length=max_length,
+            buckets=buckets,
+            tokens_per_batch=tokens_per_batch,
             thres=thres,
         )
     from .evaluate.predict_single import test_single
@@ -287,4 +295,6 @@ def evaluate_from_archive(
         use_mesh=use_mesh,
         batch_size=batch_size,
         max_length=max_length,
+        buckets=buckets,
+        tokens_per_batch=tokens_per_batch,
     )

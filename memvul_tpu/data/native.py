@@ -7,8 +7,16 @@ Design contract (see memvul_tpu/native/normalizer.cpp):
 * the native path is enabled only after a runtime **parity self-check**
   — a battery of representative documents run through both
   implementations must agree byte-for-byte;
+* every batch additionally cross-checks a **random ~1% sample** of its
+  native outputs against the Python implementation; any mismatch
+  disables the native path for the rest of the process and recomputes
+  the batch in Python;
 * any per-document native failure (NULL return) silently falls back to
-  the Python implementation, so results can never be wrong, only slower.
+  the Python implementation.
+
+Together these make the contract "parity-sampled": a divergence outside
+the self-check battery is caught probabilistically at runtime and turns
+into a slowdown, not a silent wrong result.
 
 The shared library is built on demand with g++ (toolchain is part of the
 environment); set ``MEMVUL_NATIVE=0`` to disable the native path
@@ -18,7 +26,11 @@ Performance note: per-document cost is comparable to CPython's ``re``
 (both are C regex engines); the native win is the **GIL-free thread
 pool** in ``mv_normalize_batch`` — on an N-core preprocessing host the
 corpus normalizes ~N× faster, which Python threads cannot do under the
-GIL.
+GIL.  Size cutoffs (std::regex recursion safety): single-document calls
+fall back to Python above 16KB; batch calls run on 64MB-stack pool
+threads and fall back above 256KB — so only pathological multi-hundred-KB
+bodies leave the fast path.  Non-ASCII documents always use Python (the
+byte-oriented engine disagrees with unicode ``\\s``/``\\w``).
 """
 
 from __future__ import annotations
@@ -26,6 +38,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import random
 import subprocess
 import threading
 from pathlib import Path
@@ -189,6 +202,7 @@ def normalize_batch(
         ctypes.cast(arr_out, ctypes.POINTER(ctypes.c_void_p)), n_threads,
     )
     out: List[str] = []
+    native_indices: List[int] = []
     for i, ptr in enumerate(arr_out):
         if ptr and i not in fallback_indices:
             try:
@@ -197,10 +211,43 @@ def normalize_batch(
                 )
             finally:
                 lib.mv_free(ptr)
+            native_indices.append(i)
         else:
             if ptr:
                 lib.mv_free(ptr)
             # native refused (size/encoding limits) or the document needed
             # the NUL-safe path — authoritative Python fallback
             out.append(normalize_text(texts[i]))
+    if native_indices and not _sampled_parity_ok(texts, out, native_indices):
+        # drift between the native library and the Python specification —
+        # disable native for the rest of the process and recompute this
+        # batch authoritatively
+        _disable_native("sampled runtime parity check failed")
+        return [normalize_text(t) for t in texts]
     return out
+
+
+def _sampled_parity_ok(
+    texts: Sequence[str], out: List[str], native_indices: List[int]
+) -> bool:
+    """Cross-check ~1% (min 1) of the batch's native outputs against the
+    Python specification."""
+    k = max(1, len(native_indices) // 100)
+    sample = random.sample(native_indices, min(k, len(native_indices)))
+    for i in sample:
+        expected = normalize_text(texts[i])
+        if out[i] != expected:
+            logger.error(
+                "native normalizer runtime parity FAILED on %r: native=%r "
+                "python=%r", texts[i][:80], out[i][:120], expected[:120],
+            )
+            return False
+    return True
+
+
+def _disable_native(reason: str) -> None:
+    global _lib, _state
+    with _lock:
+        _state = "disabled"
+        _lib = None
+    logger.warning("native normalizer disabled: %s", reason)

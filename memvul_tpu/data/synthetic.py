@@ -67,11 +67,28 @@ def research_view_records() -> List[Dict[str, str]]:
     return records
 
 
+def _body_with_length(rng: random.Random, phrases: List[str], base: str) -> str:
+    """Compose an issue body with a long-tailed word count, mimicking real
+    GitHub issues: lognormal with median ~100 words (≈130 wordpieces),
+    ~10-15% of reports exceeding the 512-wordpiece eval cap — so the
+    bucketed batcher sees a realistic mix rather than uniform shorts."""
+    target = int(rng.lognormvariate(4.6, 1.0))  # median e^4.6 ≈ 100 words
+    target = max(5, min(target, 2000))
+    parts = [base]
+    words = len(base.split())
+    while words < target:
+        p = rng.choice(phrases)
+        parts.append(p)
+        words += len(p.split())
+    return " ".join(parts)
+
+
 def generate_corpus(
     num_projects: int = 8,
     reports_per_project: int = 24,
     positive_rate: float = 0.25,
     seed: int = 0,
+    realistic_lengths: bool = False,
 ) -> Tuple[List[Dict], Dict[str, Dict]]:
     """Build (issue_reports, cve_dict)."""
     rng = random.Random(seed)
@@ -94,11 +111,14 @@ def generate_corpus(
                     "CWE_ID": f"CWE-{cwe}",
                     "CVE_Description": f"{phrase} in project {project}",
                 }
+                body = f"{phrase} affecting version NUMBERTAG"
+                if realistic_lengths:
+                    body = _body_with_length(rng, _VULN_PHRASES, body)
                 reports.append(
                     {
                         "Issue_Url": url,
                         "Issue_Title": f"security report {i}",
-                        "Issue_Body": f"{phrase} affecting version NUMBERTAG",
+                        "Issue_Body": body,
                         "Security_Issue_Full": "1",
                         "CVE_ID": cve_id,
                         "Issue_Created_At": "2021-01-01T00:00:00Z",
@@ -106,11 +126,14 @@ def generate_corpus(
                     }
                 )
             else:
+                body = rng.choice(_BENIGN_PHRASES)
+                if realistic_lengths:
+                    body = _body_with_length(rng, _BENIGN_PHRASES, body)
                 reports.append(
                     {
                         "Issue_Url": url,
                         "Issue_Title": f"issue {i}",
-                        "Issue_Body": rng.choice(_BENIGN_PHRASES),
+                        "Issue_Body": body,
                         "Security_Issue_Full": "0",
                         "CVE_ID": "",
                         "Issue_Created_At": "2021-01-01T00:00:00Z",

@@ -26,7 +26,11 @@ from ..data.batching import (
     LABELS_SIAMESE,
     CachedEncoder,
     batches_from_instances,
+    bucket_batch_sizes,
+    bucketed_batches_from_instances,
+    inflight_pipeline,
     prefetch,
+    validate_buckets,
 )
 from ..data.readers import MemoryReader
 from ..models.memory import MemoryModel, anchor_probs
@@ -47,6 +51,7 @@ class SiamesePredictor:
         batch_size: int = 512,
         max_length: int = 512,
         buckets: Optional[Sequence[int]] = None,
+        tokens_per_batch: Optional[int] = None,
         anchor_chunk: int = 128,
     ) -> None:
         self.model = model
@@ -54,7 +59,15 @@ class SiamesePredictor:
         self.batch_size = batch_size
         self.anchor_chunk = anchor_chunk
         self.encoder = CachedEncoder(tokenizer, max_length=max_length)
-        self.buckets = tuple(buckets) if buckets else None
+        self.buckets = validate_buckets(buckets, max_length) if buckets else None
+        # constant-token-budget batching: short buckets run bigger batches
+        if self.buckets and tokens_per_batch:
+            n_data = mesh.shape.get("data", 1) if mesh is not None else 1
+            self.bucket_sizes = bucket_batch_sizes(
+                self.buckets, tokens_per_batch, multiple_of=8 * n_data
+            )
+        else:
+            self.bucket_sizes = None
         self.params = replicate(params, mesh) if mesh is not None else params
         self.anchor_bank = None  # [A, D] device array
         self.anchor_labels: List[str] = []
@@ -103,27 +116,50 @@ class SiamesePredictor:
     # -- phase 2: streaming scoring ------------------------------------------
 
     def score_instances(
-        self, instances: Iterable[Dict], prefetch_depth: int = 4
+        self,
+        instances: Iterable[Dict],
+        prefetch_depth: int = 4,
+        inflight: int = 2,
     ) -> Iterator[Tuple[np.ndarray, List[Dict]]]:
         """Yields (per-report best anchor probabilities [b, A], metas) per
-        batch, padding rows removed."""
+        batch, padding rows removed.
+
+        The device dispatch is asynchronous: up to ``inflight`` batches are
+        queued on the accelerator before the oldest result is pulled to
+        host, so the host-side ``np.asarray`` sync never leaves the chip
+        idle between steps (the per-batch host sync was the round-1
+        throughput leak).  With buckets set, batches arrive length-binned
+        via :func:`bucketed_batches_from_instances`.
+        """
         if self.anchor_bank is None:
             raise RuntimeError("call encode_anchors() first")
-        batches = batches_from_instances(
-            instances,
-            self.encoder,
-            batch_size=self.batch_size,
-            label_map=LABELS_SIAMESE,
-            buckets=self.buckets,
-            pad_to_max=self.buckets is None,
-        )
-        for batch in prefetch(batches, depth=prefetch_depth):
+        if self.buckets is not None:
+            batches = bucketed_batches_from_instances(
+                instances,
+                self.encoder,
+                batch_size=self.bucket_sizes or self.batch_size,
+                label_map=LABELS_SIAMESE,
+                buckets=self.buckets,
+            )
+        else:
+            batches = batches_from_instances(
+                instances,
+                self.encoder,
+                batch_size=self.batch_size,
+                label_map=LABELS_SIAMESE,
+                pad_to_max=True,
+            )
+        def dispatch(batch):
             sample = batch["sample1"]
             if self.mesh is not None:
                 sample = shard_batch(sample, self.mesh)
-            probs = np.asarray(self._score_fn(self.params, sample, self.anchor_bank))
-            real = len(batch["meta"])
-            yield probs[:real], batch["meta"]
+            return self._score_fn(self.params, sample, self.anchor_bank)
+
+        for dev, batch in inflight_pipeline(
+            prefetch(batches, depth=prefetch_depth), dispatch, inflight=inflight
+        ):
+            metas = batch["meta"]
+            yield np.asarray(dev)[: len(metas)], metas
 
     def predict_file(
         self,
@@ -177,6 +213,8 @@ def test_siamese(
     use_mesh: bool = True,
     batch_size: int = 512,
     max_length: int = 512,
+    buckets: Optional[Sequence[int]] = None,
+    tokens_per_batch: Optional[int] = None,
     thres: float = 0.5,
 ) -> Dict[str, float]:
     """End-to-end evaluation mirroring the reference's ``test_siamese``
@@ -185,7 +223,14 @@ def test_siamese(
     if mesh is None and use_mesh and len(jax.devices()) > 1:
         mesh = create_mesh()
     predictor = SiamesePredictor(
-        model, params, tokenizer, mesh=mesh, batch_size=batch_size, max_length=max_length
+        model,
+        params,
+        tokenizer,
+        mesh=mesh,
+        batch_size=batch_size,
+        max_length=max_length,
+        buckets=buckets,
+        tokens_per_batch=tokens_per_batch,
     )
     predictor.encode_anchors(reader.read_anchors(str(golden_file)))
     eval_metrics = predictor.predict_file(reader, test_file, out_results)

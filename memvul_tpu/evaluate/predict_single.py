@@ -21,7 +21,11 @@ from ..data.batching import (
     LABELS_BINARY,
     CachedEncoder,
     batches_from_instances,
+    bucket_batch_sizes,
+    bucketed_batches_from_instances,
+    inflight_pipeline,
     prefetch,
+    validate_buckets,
 )
 from ..data.readers import DatasetReader, SingleReader
 from ..parallel.mesh import create_mesh, replicate, shard_batch
@@ -42,12 +46,20 @@ class SinglePredictor:
         batch_size: int = 512,
         max_length: int = 512,
         buckets: Optional[Sequence[int]] = None,
+        tokens_per_batch: Optional[int] = None,
     ) -> None:
         self.model = model
         self.mesh = mesh
         self.batch_size = batch_size
         self.encoder = CachedEncoder(tokenizer, max_length=max_length)
-        self.buckets = tuple(buckets) if buckets else None
+        self.buckets = validate_buckets(buckets, max_length) if buckets else None
+        if self.buckets and tokens_per_batch:
+            n_data = mesh.shape.get("data", 1) if mesh is not None else 1
+            self.bucket_sizes = bucket_batch_sizes(
+                self.buckets, tokens_per_batch, multiple_of=8 * n_data
+            )
+        else:
+            self.bucket_sizes = None
         self.params = replicate(params, mesh) if mesh is not None else params
         self._probs_fn = jax.jit(
             lambda p, b: jax.nn.softmax(
@@ -62,43 +74,58 @@ class SinglePredictor:
         out_path: Union[str, Path],
         split: Optional[str] = None,
     ) -> Dict[str, float]:
-        batches = batches_from_instances(
-            reader.read(str(test_path), split=split),
-            self.encoder,
-            batch_size=self.batch_size,
-            label_map=LABELS_BINARY,
-            buckets=self.buckets,
-            pad_to_max=self.buckets is None,
-        )
+        if self.buckets is not None:
+            batches = bucketed_batches_from_instances(
+                reader.read(str(test_path), split=split),
+                self.encoder,
+                batch_size=self.bucket_sizes or self.batch_size,
+                label_map=LABELS_BINARY,
+                buckets=self.buckets,
+            )
+        else:
+            batches = batches_from_instances(
+                reader.read(str(test_path), split=split),
+                self.encoder,
+                batch_size=self.batch_size,
+                label_map=LABELS_BINARY,
+                pad_to_max=True,
+            )
         labels: List[int] = []
         preds: List[int] = []
         scores: List[float] = []
         n = 0
         start = time.perf_counter()
+
+        def dispatch(batch):
+            sample = batch["sample1"]
+            if self.mesh is not None:
+                sample = shard_batch(sample, self.mesh)
+            return self._probs_fn(self.params, sample)
+
+        def _drain(dev_probs, metas, f):
+            nonlocal n
+            probs = np.asarray(dev_probs)
+            records = []
+            for row, meta in zip(probs[: len(metas)], metas):
+                p_pos = float(row[POS_INDEX])
+                predicted = int(np.argmax(row))
+                records.append(
+                    {
+                        "Issue_Url": meta.get("Issue_Url"),
+                        "label": meta.get("label"),
+                        "predict": "pos" if predicted == POS_INDEX else "neg",
+                        "prob": p_pos,
+                    }
+                )
+                labels.append(0 if meta.get("label") == "neg" else 1)
+                preds.append(1 if predicted == POS_INDEX else 0)
+                scores.append(p_pos)
+            n += len(metas)
+            f.write(json.dumps(records) + "\n")
+
         with open(out_path, "w") as f:
-            for batch in prefetch(batches):
-                sample = batch["sample1"]
-                if self.mesh is not None:
-                    sample = shard_batch(sample, self.mesh)
-                probs = np.asarray(self._probs_fn(self.params, sample))
-                real = len(batch["meta"])
-                records = []
-                for row, meta in zip(probs[:real], batch["meta"]):
-                    p_pos = float(row[POS_INDEX])
-                    predicted = int(np.argmax(row))
-                    records.append(
-                        {
-                            "Issue_Url": meta.get("Issue_Url"),
-                            "label": meta.get("label"),
-                            "predict": "pos" if predicted == POS_INDEX else "neg",
-                            "prob": p_pos,
-                        }
-                    )
-                    labels.append(0 if meta.get("label") == "neg" else 1)
-                    preds.append(1 if predicted == POS_INDEX else 0)
-                    scores.append(p_pos)
-                n += real
-                f.write(json.dumps(records) + "\n")
+            for dev, batch in inflight_pipeline(prefetch(batches), dispatch):
+                _drain(dev, batch["meta"], f)
         elapsed = time.perf_counter() - start
         logger.info(
             "scored %d reports in %.1fs (%.0f reports/s)", n, elapsed, n / max(elapsed, 1e-9)
@@ -121,12 +148,21 @@ def test_single(
     use_mesh: bool = True,
     batch_size: int = 512,
     max_length: int = 512,
+    buckets: Optional[Sequence[int]] = None,
+    tokens_per_batch: Optional[int] = None,
 ) -> Dict[str, float]:
     reader = reader or SingleReader()
     if mesh is None and use_mesh and len(jax.devices()) > 1:
         mesh = create_mesh()
     predictor = SinglePredictor(
-        model, params, tokenizer, mesh=mesh, batch_size=batch_size, max_length=max_length
+        model,
+        params,
+        tokenizer,
+        mesh=mesh,
+        batch_size=batch_size,
+        max_length=max_length,
+        buckets=buckets,
+        tokens_per_batch=tokens_per_batch,
     )
     measured = predictor.predict_file(reader, test_file, out_results)
     if out_metrics is not None:

@@ -22,6 +22,7 @@
 #include <atomic>
 #include <cstdlib>
 #include <cstring>
+#include <pthread.h>
 #include <regex>
 #include <string>
 #include <thread>
@@ -35,10 +36,15 @@ using std::string;
 
 // libstdc++'s std::regex executor recurses per matched character for
 // quantified alternations (kUrl, kApiCatchall); large documents can
-// overflow the thread stack, which catch(...) cannot intercept.  16KB
-// keeps worst-case recursion far below the 8MB stack; bigger documents
-// fall back to Python (rare in issue-report corpora).
-constexpr size_t kMaxDocBytes = 16 << 10;
+// overflow the thread stack, which catch(...) cannot intercept.  The
+// single-document entry runs on the CALLER's thread (stack size unknown,
+// typically 8MB) so it keeps a conservative 16KB cap; the batch entry
+// creates its own pool threads with 64MB stacks, which safely covers
+// 256KB documents (≈256K frames × ~128B ≪ 64MB) — issue bodies with
+// large pasted logs stay on the fast path there.
+constexpr size_t kMaxDocBytesCallerStack = 16 << 10;
+constexpr size_t kMaxDocBytesPoolStack = 256 << 10;
+constexpr size_t kPoolThreadStackBytes = 64ull << 20;
 constexpr size_t kMaxApiSpan = 150;       // normalize.py _MAX_API_SPAN
 
 // ---------------------------------------------------------------------------
@@ -236,10 +242,10 @@ string normalize_one(const string& input) {
   return collapse_spaces(content);
 }
 
-char* normalize_or_null(const char* text) {
+char* normalize_or_null(const char* text, size_t max_bytes) {
   if (text == nullptr) return nullptr;
   size_t len = std::strlen(text);
-  if (len > kMaxDocBytes) return nullptr;  // caller falls back to Python
+  if (len > max_bytes) return nullptr;  // caller falls back to Python
   // non-ASCII documents fall back: byte-oriented std::regex disagrees
   // with Python's unicode-aware \s/\w on e.g. U+00A0, and correctness
   // beats speed by contract
@@ -262,28 +268,65 @@ extern "C" {
 
 // One document. Returns a malloc'd NUL-terminated string (free with
 // mv_free) or NULL when the caller should use the Python fallback.
-char* mv_normalize(const char* text) { return normalize_or_null(text); }
+// Runs on the caller's thread, so only small documents are accepted.
+char* mv_normalize(const char* text) {
+  return normalize_or_null(text, kMaxDocBytesCallerStack);
+}
 
 void mv_free(char* p) { std::free(p); }
 
-// Batch over a thread pool: out[i] receives mv_normalize(texts[i]).
-// Each out[i] must be released with mv_free (NULL entries mean fallback).
+namespace {
+
+struct BatchJob {
+  const char** texts;
+  char** out;
+  int n;
+  std::atomic<int>* next;
+  size_t max_bytes;  // pool threads: 256KB; inline fallback: 16KB
+};
+
+void* batch_worker(void* arg) {
+  auto* job = static_cast<BatchJob*>(arg);
+  while (true) {
+    int i = job->next->fetch_add(1);
+    if (i >= job->n) break;
+    job->out[i] = normalize_or_null(job->texts[i], job->max_bytes);
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+// Batch over a thread pool: out[i] receives the normalization of
+// texts[i].  Each out[i] must be released with mv_free (NULL entries
+// mean Python fallback).  Pool threads get 64MB stacks so documents up
+// to kMaxDocBytesPoolStack survive std::regex recursion.
 void mv_normalize_batch(const char** texts, int n, char** out,
                         int n_threads) {
   if (n <= 0) return;
   int workers = std::max(1, n_threads);
   workers = std::min(workers, n);
-  std::vector<std::thread> pool;
   std::atomic<int> next{0};
-  auto run = [&]() {
-    while (true) {
-      int i = next.fetch_add(1);
-      if (i >= n) break;
-      out[i] = normalize_or_null(texts[i]);
+  BatchJob job{texts, out, n, &next, kMaxDocBytesPoolStack};
+  pthread_attr_t attr;
+  pthread_attr_init(&attr);
+  pthread_attr_setstacksize(&attr, kPoolThreadStackBytes);
+  std::vector<pthread_t> pool;
+  pool.reserve(workers);
+  for (int t = 0; t < workers; ++t) {
+    pthread_t th;
+    if (pthread_create(&th, &attr, batch_worker, &job) == 0) {
+      pool.push_back(th);
     }
-  };
-  for (int t = 0; t < workers; ++t) pool.emplace_back(run);
-  for (auto& th : pool) th.join();
+  }
+  pthread_attr_destroy(&attr);
+  if (pool.empty()) {
+    // thread creation failed — run inline on the CALLER's stack, so only
+    // caller-stack-safe document sizes may take the native path
+    job.max_bytes = kMaxDocBytesCallerStack;
+    batch_worker(&job);
+  }
+  for (pthread_t th : pool) pthread_join(th, nullptr);
 }
 
 int mv_abi_version() { return 1; }

@@ -1,18 +1,27 @@
-"""Blockwise (flash) attention via Pallas for long sequences.
+"""Blockwise (flash) attention dispatch for long sequences.
 
 At the reference's sequence lengths (256 train / 512 eval) XLA's fused
-attention is already near-roofline, so the XLA path is the default; this
-kernel exists for the long-context stretch where the [T, T] score matrix
-stops fitting in VMEM.  On non-TPU backends it falls back to the einsum
-formulation so tests run anywhere.
+attention is already near-roofline, so the XLA path is the default; the
+Pallas kernel (:mod:`.flash_kernel`) exists for the long-context stretch
+where the [B, H, T, T] score tensor stops fitting — its footprint stays
+O(T·D).  Dispatch rules:
+
+* TPU + key-only bias (the encoder's padding mask): Pallas kernel;
+* TPU + structured [B, H, Tq, Tk] bias: XLA (logged once) — the kernel
+  deliberately supports only the bias shape the models produce;
+* non-TPU backends: XLA (mathematically identical; the kernel itself is
+  exercised on CPU via interpret mode in tests/test_flash_kernel.py).
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+_warned_bias = False
 
 
 def flash_attention_or_fallback(
@@ -21,17 +30,21 @@ def flash_attention_or_fallback(
     value: jax.Array,
     bias: Optional[jax.Array] = None,
 ) -> jax.Array:
+    global _warned_bias
     if jax.default_backend() == "tpu":
+        from .flash_kernel import UnsupportedBiasError, flash_attention
+
         try:
-            return _pallas_flash(query, key, value, bias)
-        except (ImportError, NotImplementedError):
-            pass  # kernel not built yet — XLA fallback below
+            return flash_attention(query, key, value, bias)
+        except UnsupportedBiasError:
+            # only the documented bias-shape rejection falls back; any
+            # other kernel failure propagates so regressions surface
+            if not _warned_bias:
+                _warned_bias = True
+                logger.info(
+                    "flash kernel: non-key-only bias %s — using XLA attention",
+                    None if bias is None else bias.shape,
+                )
     from ..attention import _xla_attention
 
     return _xla_attention(query, key, value, bias, None, 0.0, True)
-
-
-def _pallas_flash(query, key, value, bias):
-    from .flash_kernel import flash_attention
-
-    return flash_attention(query, key, value, bias)

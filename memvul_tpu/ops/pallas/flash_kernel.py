@@ -1,0 +1,254 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Blockwise (FlashAttention-style) exact attention: the [Tq, Tk] score
+matrix never materializes in HBM — each grid step streams one key/value
+block through VMEM and folds it into a running online-softmax
+accumulator.  This is the long-context capability the reference lacks
+entirely (it *folds* long inputs instead, custom_PTM_embedder.py:244-381);
+at 1k-4k tokens the XLA path's [B, H, T, T] score tensor dominates HBM
+traffic while this kernel's footprint stays O(T·D).
+
+Scope (by design, documented at the call site in ops/attention.py):
+
+* forward pass only — the backward pass recomputes attention through the
+  XLA formulation via ``jax.custom_vjp`` (correct gradients, XLA-sized
+  memory; the flash win targets inference/eval where long sequences
+  actually occur in this workload);
+* key-only additive bias (the encoder's padding mask, broadcastable to
+  [B, 1, 1, Tk]); a full [B, H, Tq, Tk] bias falls back to XLA;
+* no dropout (callers route dropout through XLA).
+
+Numerics match the XLA path: scores and softmax accumulate in float32
+(MXU matmuls via ``preferred_element_type``), output cast back to the
+query dtype.  All-masked rows produce the same uniform-average artifact
+as XLA softmax — downstream pooling drops padded rows either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+class UnsupportedBiasError(ValueError):
+    """The bias carries real query/head structure the kernel does not
+    support — callers catch THIS (not ValueError, which would also swallow
+    genuine tracing/lowering failures) to fall back to XLA."""
+
+
+def _flash_fwd_kernel(
+    bias_ref,  # [1, block_k] f32 — key-position additive bias
+    q_ref,     # [1, block_q, d]
+    k_ref,     # [1, block_k, d]
+    v_ref,     # [1, block_k, d]
+    out_ref,   # [1, block_q, d]
+    m_scratch,    # [block_q, 128] f32 running max (lane-replicated)
+    l_scratch,    # [block_q, 128] f32 running denominator
+    acc_scratch,  # [block_q, d] f32 output accumulator
+    *,
+    scale: float,
+    num_k_blocks: int,
+):
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [block_q, block_k]
+    s = s * scale + bias_ref[0][None, :]
+
+    m_prev = m_scratch[:, :1]  # [block_q, 1]
+    l_prev = l_scratch[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    correction = jnp.exp(m_prev - m_new)  # [block_q, 1]
+    p = jnp.exp(s - m_new)  # [block_q, block_k]
+    l_new = l_prev * correction + p.sum(axis=-1, keepdims=True)
+    m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+    l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, d]
+    acc_scratch[:] = acc_scratch[:] * correction + pv
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scratch[:, :1], 1e-30)
+        out_ref[0] = (acc_scratch[:] / denom).astype(out_ref.dtype)
+
+
+def _flash_forward(
+    query: jax.Array,   # [B, Tq, H, D]
+    key: jax.Array,     # [B, Tk, H, D]
+    value: jax.Array,   # [B, Tk, H, D]
+    key_bias: jax.Array,  # [B, Tk] f32 additive
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    b, t_q, h, d = query.shape
+    t_k = key.shape[1]
+    scale = 1.0 / (d ** 0.5)
+
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_k) % block_k
+    if pad_q:
+        query = jnp.pad(query, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    if pad_k:
+        key = jnp.pad(key, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        value = jnp.pad(value, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        # padded keys must never win the softmax
+        key_bias = jnp.pad(key_bias, ((0, 0), (0, pad_k)), constant_values=_NEG_INF)
+    tq_p, tk_p = t_q + pad_q, t_k + pad_k
+
+    # [B, T, H, D] -> [B*H, T, D]: each (batch, head) pair is one
+    # independent attention problem; the grid walks key blocks innermost
+    qt = query.transpose(0, 2, 1, 3).reshape(b * h, tq_p, d)
+    kt = key.transpose(0, 2, 1, 3).reshape(b * h, tk_p, d)
+    vt = value.transpose(0, 2, 1, 3).reshape(b * h, tk_p, d)
+
+    num_q_blocks = tq_p // block_q
+    num_k_blocks = tk_p // block_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, num_k_blocks=num_k_blocks
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q_blocks, num_k_blocks),
+        in_specs=[
+            # bias is per-batch (shared across heads): row = bh // h —
+            # lax.div (truncating) instead of Python // because Mosaic
+            # rejects floor-division's negative-operand select in index maps
+            pl.BlockSpec(
+                (1, block_k),
+                lambda bh, qi, kj: (jax.lax.div(bh, h), kj),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q, d), lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, qi, kj: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), query.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(key_bias.astype(jnp.float32), qt, kt, vt)
+
+    out = out.reshape(b, h, tq_p, d).transpose(0, 2, 1, 3)
+    if pad_q:
+        out = out[:, :t_q]
+    return out
+
+
+def _squeeze_key_bias(bias: Optional[jax.Array], b: int, t_k: int) -> Optional[jax.Array]:
+    """A bias broadcastable to [B, 1, 1, Tk] reduced to [B, Tk]; None when
+    the bias carries real query/head structure (caller falls back)."""
+    if bias is None:
+        return jnp.zeros((b, t_k), jnp.float32)
+    if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
+        return None
+    if bias.shape[3] != t_k:
+        return None
+    out = bias[:, 0, 0, :].astype(jnp.float32)
+    if out.shape[0] == 1 and b > 1:
+        out = jnp.broadcast_to(out, (b, t_k))
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention_vjp(query, key, value, key_bias, block_q, block_k, interpret):
+    return _flash_forward(query, key, value, key_bias, block_q, block_k, interpret)
+
+
+def _flash_vjp_fwd(query, key, value, key_bias, block_q, block_k, interpret):
+    out = _flash_forward(query, key, value, key_bias, block_q, block_k, interpret)
+    return out, (query, key, value, key_bias)
+
+
+def _flash_vjp_bwd(block_q, block_k, interpret, residuals, g):
+    # backward recomputes attention through the XLA formulation — correct
+    # gradients at XLA-sized memory; the flash memory win is forward-only
+    query, key, value, key_bias = residuals
+    from ..attention import _xla_attention
+
+    bias = key_bias[:, None, None, :]
+
+    def ref(q, k, v):
+        return _xla_attention(q, k, v, bias, None, 0.0, True)
+
+    _, vjp = jax.vjp(ref, query, key, value)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_attention_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    bias: Optional[jax.Array] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Blockwise exact attention.  [B, T, H, D] in, [B, T, H, D] out.
+
+    ``bias`` must be key-only (broadcastable to [B, 1, 1, Tk]) — raises
+    ValueError otherwise so the caller can fall back to XLA explicitly.
+    ``interpret`` defaults to True off-TPU so tests exercise the kernel
+    logic anywhere.
+    """
+    if query.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D], got {query.shape}")
+    b, _, _, _ = query.shape
+    t_k = key.shape[1]
+    key_bias = _squeeze_key_bias(bias, b, t_k)
+    if key_bias is None:
+        raise UnsupportedBiasError(
+            "flash kernel supports key-only bias (broadcastable to "
+            f"[B, 1, 1, Tk]); got shape {None if bias is None else bias.shape}"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention_vjp(
+        query, key, value, key_bias, block_q, block_k, interpret
+    )

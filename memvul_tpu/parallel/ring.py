@@ -116,8 +116,14 @@ def ring_attention(
         None,
         length=axis_size - 1,
     )
-    acc, _, denom = accumulate(acc, row_max, denom, key, value, key_bias)
+    acc, row_max, denom = accumulate(acc, row_max, denom, key, value, key_bias)
     out = acc / jnp.maximum(denom.transpose(0, 2, 1)[..., None], 1e-30)
+    # a query row whose keys are masked in EVERY block never escapes the
+    # mask floor (row_max stays ~finfo.min); its softmax is a uniform
+    # average over padding — return zeros instead of that artifact (real
+    # scores are bounded far above neg/2, so the test is exact)
+    alive = row_max > neg * 0.5  # [B, H, T_q]
+    out = jnp.where(alive.transpose(0, 2, 1)[..., None], out, 0.0)
     return out.astype(query.dtype)
 
 

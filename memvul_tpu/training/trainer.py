@@ -376,18 +376,44 @@ class MemoryTrainer:
             state["ema_params"] = jax.device_get(self.ema_params)
         return state
 
+    def _restore_templates(self):
+        """The expected checkpoint structure, plus the ema-toggled variant:
+        resuming a serialization dir written before/after ``ema_decay`` was
+        flipped must degrade gracefully rather than die inside orbax's
+        structure match."""
+        full = self._state_dict()
+        alt = dict(full)
+        if "ema_params" in alt:
+            del alt["ema_params"]
+        else:
+            alt["ema_params"] = jax.device_get(self.params)
+        return full, alt
+
     def maybe_restore(self) -> bool:
         if self.checkpointer is None:
             return False
-        restored = self.checkpointer.restore_latest(self._state_dict())
+        full, alt = self._restore_templates()
+        try:
+            restored = self.checkpointer.restore_latest(full)
+        except Exception:
+            logger.warning(
+                "checkpoint structure mismatch (ema_decay toggled?) — "
+                "retrying with the alternate template"
+            )
+            restored = self.checkpointer.restore_latest(alt)
         if restored is None:
             return False
         _, state = restored
         self.params = state["params"]
         self.opt_state = state["opt_state"]
         self.rng = jnp.asarray(state["rng"])
-        if self.ema_params is not None and "ema_params" in state:
-            self.ema_params = state["ema_params"]
+        if self.ema_params is not None:
+            if "ema_params" in state:
+                self.ema_params = state["ema_params"]
+            else:
+                # ema was enabled after this checkpoint was written —
+                # seed the average from the restored live params
+                self.ema_params = jax.tree_util.tree_map(jnp.copy, self.params)
         meta = state["meta"]
         self.step = int(meta["step"])
         self.epoch = int(meta["epoch"]) + 1  # resume after the saved epoch
@@ -416,7 +442,13 @@ class MemoryTrainer:
         live = self.ema_params if self.ema_params is not None else self.params
         if self.checkpointer is None:
             return live
-        state = self.checkpointer.restore_best(self._state_dict())
+        full, alt = self._restore_templates()
+        try:
+            state = self.checkpointer.restore_best(full)
+        except Exception:
+            state = self.checkpointer.restore_best(alt)
         if state is None:
             return live
-        return state.get("ema_params") or state["params"]
+        if "ema_params" in state:
+            return state["ema_params"]
+        return state["params"]

@@ -56,6 +56,30 @@ def test_ema_tracks_behind_live_params(ws):
     np.testing.assert_array_equal(_leaf(trainer.best_params()), ema)
 
 
+def test_resume_across_ema_toggle(ws, tmp_path):
+    """A serialization dir written WITHOUT ema must restore into a trainer
+    WITH ema_decay set (ema seeded from live params), and vice versa —
+    toggling ema_decay on an existing dir degrades gracefully."""
+    ser = str(tmp_path / "toggle")
+    t1 = make_trainer(ws, serialization_dir=ser)
+    t1.train()
+    assert t1.ema_params is None
+
+    # off -> on: ema seeded from the restored params
+    t2 = make_trainer(ws, serialization_dir=ser, ema_decay=0.9, num_epochs=2)
+    assert t2.maybe_restore()
+    assert t2.ema_params is not None
+    np.testing.assert_array_equal(_leaf(t2.ema_params), _leaf(t2.params))
+
+    # on -> off: checkpoint with ema restores into a plain trainer
+    ser2 = str(tmp_path / "toggle2")
+    t3 = make_trainer(ws, serialization_dir=ser2, ema_decay=0.9)
+    t3.train()
+    t4 = make_trainer(ws, serialization_dir=ser2, num_epochs=2)
+    assert t4.maybe_restore()
+    assert t4.ema_params is None
+
+
 def test_ema_disabled_by_default(ws):
     trainer = make_trainer(ws)
     assert trainer.ema_params is None

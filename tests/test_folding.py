@@ -81,3 +81,54 @@ def test_unfold_mask_excludes_partial_segment_sep_and_padding():
     stream, valid = unfold_embeddings(emb, s, folded_mask=fmask)
     # exactly the 10 content tokens are valid — no SEP, no padding
     assert int(valid.sum()) == 10
+
+
+def test_fold_segment0_equals_truncation_pooled_output():
+    """The documented equivalence claim (folding.py docstring): for a
+    CLS-pooled classifier, encoding segment 0 of a folded long input gives
+    the SAME pooled vector as encoding the truncated input — segment 0 IS
+    the truncation.  Verified at the encoder level on a real model."""
+    import jax
+    from memvul_tpu.models import BertConfig, SingleModel
+    from memvul_tpu.models.bert import BertEncoder
+
+    max_length = 16
+    cfg = BertConfig.tiny(vocab_size=64)
+    model = SingleModel(cfg)
+    encoder = BertEncoder(cfg)
+
+    # a long input: 40 content tokens, CLS/SEP framed
+    tokens = [(5 + i) % 60 + 4 for i in range(40)]
+    ids, mask = frame(tokens, 48)
+
+    folded, fmask, s = fold_tokens(
+        ids[None], mask[None], max_length=max_length,
+        cls_id=CLS, sep_id=SEP, pad_id=PAD,
+    )
+    assert s > 1
+
+    # truncation: [CLS] t[:L-2] [SEP] — the reference reader's eval path
+    trunc = np.full((1, max_length), PAD, np.int32)
+    trunc[0, : max_length - 1] = ids[: max_length - 1]
+    trunc[0, max_length - 1] = SEP
+    tmask = (trunc != PAD).astype(np.int32)
+
+    # token-level: segment 0 is exactly the truncated sequence
+    np.testing.assert_array_equal(folded[0], trunc[0])
+    np.testing.assert_array_equal(fmask[0], tmask[0])
+
+    params = model.init(
+        jax.random.PRNGKey(0),
+        {"input_ids": trunc, "attention_mask": tmask},
+    )
+
+    def pooled(batch_ids, batch_mask):
+        hidden = encoder.apply(
+            {"params": params["params"]["bert"]},
+            batch_ids, batch_mask, deterministic=True,
+        )
+        return np.asarray(hidden[:, 0, :], np.float32)  # CLS vector
+
+    out_trunc = pooled(trunc, tmask)
+    out_fold = pooled(folded, fmask)  # all segments batched
+    np.testing.assert_allclose(out_fold[0], out_trunc[0], atol=1e-5, rtol=1e-5)

@@ -91,6 +91,68 @@ def test_sharded_matches_unsharded(ws, memory_setup, tmp_path):
             )
 
 
+def test_bucketed_scoring_matches_pad_to_max(ws, memory_setup, tmp_path):
+    """Length-binned batching re-orders reports but must not change any
+    per-report anchor probability (buckets cover max_length, so no extra
+    truncation) — the throughput path is score-equivalent."""
+    model, params, reader = memory_setup
+    r_bucket = tmp_path / "bucket_result.json"
+    r_flat = tmp_path / "flat_result.json"
+    pred_bucket = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=8, max_length=64,
+        buckets=(16, 32, 64),
+    )
+    pred_flat = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=8, max_length=64
+    )
+    for pred, path in [(pred_bucket, r_bucket), (pred_flat, r_flat)]:
+        pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+        pred.predict_file(reader, ws["paths"]["test"], path)
+    by_url = {}
+    for line in r_flat.read_text().splitlines():
+        for rec in json.loads(line):
+            by_url[rec["Issue_Url"]] = rec
+    n = 0
+    for line in r_bucket.read_text().splitlines():
+        for rec in json.loads(line):
+            ref = by_url.pop(rec["Issue_Url"])
+            assert rec["label"] == ref["label"]
+            for anchor, p in rec["predict"].items():
+                np.testing.assert_allclose(p, ref["predict"][anchor], rtol=1e-4, atol=1e-5)
+            n += 1
+    assert not by_url and n > 0  # same report set, nothing lost or duplicated
+
+
+def test_bucketed_batch_shapes(ws):
+    """Per-bucket token budget: short buckets run proportionally larger
+    batches; every emitted batch has a bucket-sized sequence dim."""
+    from memvul_tpu.data.batching import (
+        CachedEncoder,
+        bucket_batch_sizes,
+        bucketed_batches_from_instances,
+    )
+
+    sizes = bucket_batch_sizes((16, 32, 64), tokens_per_batch=256)
+    assert sizes == {16: 16, 32: 8, 64: 8}  # floor at multiple_of=8
+    encoder = CachedEncoder(ws["tokenizer"], max_length=64)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    insts = list(reader.read(ws["paths"]["test"], split="test"))
+    seen = set()
+    total = 0
+    for batch in bucketed_batches_from_instances(
+        insts, encoder, batch_size=sizes, buckets=(16, 32, 64)
+    ):
+        b, length = batch["sample1"]["input_ids"].shape
+        assert length in (16, 32, 64)
+        assert b == sizes[length]
+        assert batch["weight"].sum() == len(batch["meta"])
+        total += len(batch["meta"])
+        seen.add(length)
+    assert total == len(insts)
+
+
 def test_cal_metrics_perfect_and_inverted(tmp_path):
     # synthetic result file with known outcomes
     records = [
@@ -140,3 +202,34 @@ def test_cal_metrics_empty_result_file(tmp_path):
     f.write_text("")
     m = cal_metrics(f, thres=0.5)
     assert m["f1"] == 0.0 and m["TP"] == 0
+
+
+def test_buckets_must_cover_max_length(ws, memory_setup):
+    """Buckets smaller than max_length would silently truncate long
+    reports — constructor must reject the combination."""
+    model, params, _ = memory_setup
+    with pytest.raises(ValueError, match="truncated"):
+        SiamesePredictor(
+            model, params, ws["tokenizer"], max_length=64, buckets=(16, 32)
+        )
+    from memvul_tpu.evaluate.predict_single import SinglePredictor
+    with pytest.raises(ValueError, match="truncated"):
+        SinglePredictor(
+            model, params, ws["tokenizer"], max_length=64, buckets=(16, 32)
+        )
+
+
+def test_single_predictor_bucket_token_budget(ws):
+    """tokens_per_batch drives per-bucket batch sizes on the single path
+    too (the config field is honored end-to-end)."""
+    from memvul_tpu.evaluate.predict_single import SinglePredictor
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = SingleModel(cfg)
+    dummy = {"input_ids": np.zeros((2, 8), np.int32),
+             "attention_mask": np.ones((2, 8), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), dummy)
+    pred = SinglePredictor(
+        model, params, ws["tokenizer"], max_length=64,
+        buckets=(16, 32, 64), tokens_per_batch=512,
+    )
+    assert pred.bucket_sizes == {16: 32, 32: 16, 64: 8}

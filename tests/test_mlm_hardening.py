@@ -1,0 +1,221 @@
+"""MLM subsystem hardening: real gradient accumulation, checkpoint/resume,
+refuse-to-clobber, and the vectorized whole-word-mask collator.
+
+Reference semantics: run_mlm_wwm.py — batch 16 × accum 2 schedule
+(further_pretrain.json), output-dir guard (run_mlm_wwm.py:190-196),
+DataCollatorForWholeWordMask's 15% word masking with 80/10/10 token
+treatment.
+"""
+
+import numpy as np
+import pytest
+
+from memvul_tpu.data.synthetic import build_workspace, corpus_texts, generate_corpus
+from memvul_tpu.models import BertConfig
+from memvul_tpu.pretrain.mlm import (
+    IGNORE,
+    MLMTrainer,
+    MLMTrainerConfig,
+    continuation_flags,
+    whole_word_mask,
+)
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("mlmh"), seed=11)
+
+
+@pytest.fixture(scope="module")
+def corpus_file(tmp_path_factory):
+    reports, _ = generate_corpus(seed=5)
+    path = tmp_path_factory.mktemp("corpus") / "mlm.txt"
+    path.write_text("\n".join(corpus_texts(reports)))
+    return str(path)
+
+
+def _tiny_cfg(ws, **kw):
+    base = dict(
+        batch_size=4, grad_accum=2, max_length=32, num_epochs=2,
+        steps_per_epoch=3, learning_rate=3e-3, warmup_steps=2,
+    )
+    base.update(kw)
+    return MLMTrainerConfig(**base)
+
+
+# -- gradient accumulation -----------------------------------------------------
+
+def test_grad_accum_shapes_microbatch_stacks(ws):
+    trainer = MLMTrainer(
+        BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size),
+        ws["tokenizer"], _tiny_cfg(ws, grad_accum=3, batch_size=4),
+    )
+    lines = ["some words here to mask"] * 40
+    ids, mask, labels = next(trainer._batches(lines))
+    assert ids.shape == (3, 4, 32)  # [K, B, L]
+    assert mask.shape == (3, 4, 32) and labels.shape == (3, 4, 32)
+
+
+def test_grad_accum_is_actually_applied(ws, corpus_file):
+    """grad_accum=2 must consume twice the rows per optimizer step as
+    grad_accum=1 — the config field drives real behavior now."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    t1 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=1))
+    t2 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=2))
+    lines = ["alpha beta gamma delta"] * 64
+    s1 = next(t1._batches(lines))[0]
+    s2 = next(t2._batches(lines))[0]
+    assert s1.shape[0] * s1.shape[1] == 4
+    assert s2.shape[0] * s2.shape[1] == 8
+    out = t2.train(corpus_file)
+    assert np.isfinite(out["final_loss"])
+
+
+# -- checkpoint / resume -------------------------------------------------------
+
+def test_mlm_resume_continues_from_saved_epoch(ws, corpus_file, tmp_path):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    out_dir = str(tmp_path / "mlm_out")
+    t1 = MLMTrainer(
+        cfg, ws["tokenizer"], _tiny_cfg(ws, num_epochs=2, output_dir=out_dir)
+    )
+    r1 = t1.train(corpus_file)
+    assert len(r1["history"]) == 2
+
+    # a fresh trainer over the same dir resumes after epoch 1 and runs
+    # only the remaining epochs
+    t2 = MLMTrainer(
+        cfg, ws["tokenizer"], _tiny_cfg(ws, num_epochs=4, output_dir=out_dir)
+    )
+    r2 = t2.train(corpus_file)
+    assert t2.start_epoch == 2  # resumed, not restarted
+    assert len(r2["history"]) == 2  # epochs 2 and 3 only
+    # optimizer step counter carried over
+    assert t2.step > t1.step
+
+
+def test_mlm_refuses_to_clobber_non_checkpoint_dir(ws, tmp_path):
+    out = tmp_path / "occupied"
+    out.mkdir()
+    (out / "precious.txt").write_text("do not delete")
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    with pytest.raises(ValueError, match="not empty"):
+        MLMTrainer(
+            cfg, ws["tokenizer"], _tiny_cfg(ws, output_dir=str(out))
+        )
+    # explicit overwrite goes through
+    MLMTrainer(
+        cfg, ws["tokenizer"],
+        _tiny_cfg(ws, output_dir=str(out), overwrite_output_dir=True),
+    )
+
+
+# -- vectorized whole-word masking --------------------------------------------
+
+def _mask_setup(ws, n=256, length=48, seed=7):
+    tok = ws["tokenizer"]
+    rng = np.random.default_rng(seed)
+    texts = [
+        " ".join(rng.choice(["vulnerability", "overflow", "parser",
+                             "authentication", "renderer", "injection"], 8))
+        for _ in range(n)
+    ]
+    ids = np.full((n, length), tok.pad_id, np.int32)
+    mask = np.zeros_like(ids)
+    for i, t in enumerate(texts):
+        seq = tok.encode(t, max_length=length)
+        ids[i, : len(seq)] = seq
+        mask[i, : len(seq)] = 1
+    return tok, ids, mask, rng
+
+
+def test_wwm_masking_statistics(ws):
+    """~15% of words selected; of selected tokens ~80% become [MASK],
+    ~10% random, ~10% unchanged (HF collator behavior)."""
+    tok, ids, mask, rng = _mask_setup(ws)
+    flags = continuation_flags(tok)
+    special = [tok.pad_id, tok.cls_id, tok.sep_id]
+    masked, labels = whole_word_mask(
+        ids, mask, rng, tok.mask_id, tok.vocab_size, flags, special, 0.15
+    )
+    chosen = labels != IGNORE
+    frac_tokens = chosen.sum() / (mask.sum() - 2 * len(ids))  # minus CLS/SEP
+    assert 0.10 < frac_tokens < 0.25
+    is_masked = (masked == tok.mask_id) & chosen
+    unchanged = (masked == ids) & chosen
+    assert 0.70 < is_masked.sum() / chosen.sum() < 0.90
+    assert 0.04 < unchanged.sum() / chosen.sum() < 0.18
+    # specials and padding never masked
+    assert not chosen[ids == tok.cls_id].any()
+    assert not chosen[ids == tok.sep_id].any()
+    assert not chosen[mask == 0].any()
+    # untouched positions keep their ids
+    np.testing.assert_array_equal(masked[~chosen], ids[~chosen])
+
+
+def test_wwm_whole_words_move_together(ws):
+    """Every ## continuation shares its head's fate (the whole-word
+    property the reference collator exists for)."""
+    tok, ids, mask, rng = _mask_setup(ws, n=64, seed=9)
+    flags = continuation_flags(tok)
+    special = [tok.pad_id, tok.cls_id, tok.sep_id]
+    _, labels = whole_word_mask(
+        ids, mask, rng, tok.mask_id, tok.vocab_size, flags, special, 0.15
+    )
+    chosen = labels != IGNORE
+    B, L = ids.shape
+    for b in range(B):
+        for i in range(1, L):
+            if mask[b, i] and flags[ids[b, i]] and mask[b, i - 1] and not (
+                ids[b, i - 1] in special
+            ):
+                assert chosen[b, i] == chosen[b, i - 1], (b, i)
+
+
+def test_wwm_every_row_with_words_gets_a_mask(ws):
+    tok, ids, mask, rng = _mask_setup(ws, n=32, seed=3)
+    flags = continuation_flags(tok)
+    special = [tok.pad_id, tok.cls_id, tok.sep_id]
+    _, labels = whole_word_mask(
+        ids, mask, rng, tok.mask_id, tok.vocab_size, flags, special, 0.15
+    )
+    assert ((labels != IGNORE).sum(axis=1) >= 1).all()
+
+
+def test_wwm_empty_and_special_only_rows(ws):
+    tok = ws["tokenizer"]
+    rng = np.random.default_rng(0)
+    flags = continuation_flags(tok)
+    ids = np.array([[tok.cls_id, tok.sep_id, tok.pad_id, tok.pad_id]], np.int32)
+    mask = np.array([[1, 1, 0, 0]], np.int32)
+    masked, labels = whole_word_mask(
+        ids, mask, rng, tok.mask_id, tok.vocab_size, flags,
+        [tok.pad_id, tok.cls_id, tok.sep_id], 0.15,
+    )
+    np.testing.assert_array_equal(masked, ids)
+    assert (labels == IGNORE).all()
+
+
+def test_grad_accum_tail_stack_not_diluted(ws):
+    """An epoch-tail stack containing empty (all-padding) microbatches must
+    average loss/grads over REAL microbatches only — 1 real + 2 empty at
+    grad_accum=3 gives the same update magnitude as the real batch alone."""
+    import jax
+
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    t3 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=3))
+    t1 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=1))
+    # identical initial params by construction (same seed)
+    lines = ["alpha beta gamma delta"] * 4  # one microbatch worth of rows
+    ids1, mask1, labels1 = next(t1._batches(lines))
+    # tail stack: the single real microbatch plus 2 empty ones
+    pad = ws["tokenizer"].pad_id
+    ids3 = np.concatenate([ids1, np.full_like(ids1, pad), np.full_like(ids1, pad)])
+    mask3 = np.concatenate([mask1, np.zeros_like(mask1), np.zeros_like(mask1)])
+    from memvul_tpu.pretrain.mlm import IGNORE as IG
+    labels3 = np.concatenate([labels1, np.full_like(labels1, IG), np.full_like(labels1, IG)])
+    rng = jax.random.PRNGKey(0)
+    p3, _, loss3 = t3._train_step(t3.params, t3.opt_state, ids3, mask3, labels3, rng)
+    p1, _, loss1 = t1._train_step(t1.params, t1.opt_state, ids1, mask1, labels1, rng)
+    # loss not diluted by the empty microbatches
+    np.testing.assert_allclose(float(loss3), float(loss1), rtol=1e-5)

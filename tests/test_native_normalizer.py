@@ -104,12 +104,46 @@ def test_corrupt_library_disables_native(tmp_path, monkeypatch):
 
 def test_oversized_doc_falls_back():
     lib = get_native_normalizer()
-    big = "word " * 300_000  # >1MB → native returns NULL
+    # the single-doc entry runs on the caller's thread: 16KB cap
+    big = "word " * 4_000  # 20KB > 16KB → native returns NULL
     assert _native_one(lib, big) is None
-    # the batch API still returns the correct Python-computed result
-    out = normalize_batch([big, "small CVE-2021-2 doc"])
-    assert out[1] == normalize_text("small CVE-2021-2 doc")
+    # the batch API pool threads carry 64MB stacks: 256KB cap — a 20KB log
+    # dump stays on the native path there, >256KB falls back to Python;
+    # either way the result equals the Python specification
+    huge = "word " * 60_000  # 300KB > 256KB batch cap
+    out = normalize_batch([big, huge, "small CVE-2021-2 doc"])
     assert out[0] == normalize_text(big)
+    assert out[1] == normalize_text(huge)
+    assert out[2] == normalize_text("small CVE-2021-2 doc")
+
+
+def test_caller_stack_cap_boundary():
+    """Documents at the 16KB single-doc boundary: just-below normalizes
+    natively, just-above returns NULL (Python fallback)."""
+    lib = get_native_normalizer()
+    under = "a" * 20 + " word" * ((16 << 10) // 5 - 10)  # just under 16KB
+    assert len(under.encode()) <= 16 << 10
+    assert _native_one(lib, under) == normalize_text(under)
+    over = "b" * ((16 << 10) + 1)
+    assert _native_one(lib, over) is None
+    assert normalize_batch([over]) == [normalize_text(over)]
+
+
+def test_sampled_runtime_parity_disables_on_mismatch(monkeypatch):
+    """If a native output ever disagrees with the Python spec, the batch is
+    recomputed in Python and the native path is disabled process-wide."""
+    import memvul_tpu.data.native as native_mod
+
+    assert native_mod.native_available()
+    monkeypatch.setattr(native_mod, "_sampled_parity_ok", lambda *a: False)
+    docs = ["CVE-2021-44228 here", "plain words"]
+    out = native_mod.normalize_batch(docs)
+    assert out == [normalize_text(d) for d in docs]
+    assert not native_mod.native_available()  # disabled for the process
+    # restore for other tests (module-level state)
+    native_mod._state = None
+    native_mod._lib = None
+    assert native_mod.native_available()
 
 
 def test_preprocess_uses_batch_path():

@@ -55,6 +55,21 @@ def test_ring_matches_xla_ragged_mask(seq_mesh):
     )
 
 
+def test_ring_all_masked_sequence_returns_zeros(seq_mesh):
+    """A sequence whose keys are ALL padding must produce zero outputs for
+    every query row, not a uniform average over masked keys (the documented
+    public-API contract for all-masked rows)."""
+    q, k, v = _qkv(seed=3)
+    mask = np.ones(q.shape[:2], np.int32)
+    mask[1, :] = 0  # second sequence entirely padding
+    out = make_ring_attention(seq_mesh)(q, k, v, jnp.asarray(mask))
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+    # real sequence is untouched
+    ref = dot_product_attention(q, k, v, bias=mask_to_bias(jnp.asarray(mask)))
+    np.testing.assert_allclose(out[0], np.asarray(ref)[0], atol=1e-5, rtol=1e-5)
+
+
 def test_ring_bf16_close_to_fp32(seq_mesh):
     q, k, v = _qkv(seed=2, dtype=jnp.bfloat16)
     mask = jnp.ones(q.shape[:2], jnp.int32)
