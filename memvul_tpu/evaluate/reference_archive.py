@@ -1,0 +1,115 @@
+"""Load a reference-format ``model.tar.gz`` (PyTorch/AllenNLP) into the
+TPU-native :class:`MemoryModel`.
+
+The reference's training run leaves an archive holding ``config.json``
+(the full train config) and ``weights.th`` (the torch state dict of
+``model_memory``, reference: predict_memory.py:62-67).  This module maps
+that state dict onto our parameter tree so a checkpoint trained by the
+reference pipeline can be scored by this framework — the archive-level
+half of the F1-parity chain (the tokenizer half lives in
+tests/test_tokenizer_hf_parity.py).
+
+State-dict layout consumed (reference: model_memory.py:63-73):
+
+* ``_text_field_embedder.token_embedder_tokens.transformer_model.*`` —
+  the HF BertModel (mapped by :mod:`memvul_tpu.models.convert`);
+* ``_bert_pooler.pooler.dense.*`` — the fine-tuned tanh pooler (the
+  transformer's own frozen pooler copy is ignored, as in the reference
+  forward path which only calls ``_bert_pooler``);
+* ``_projector_single._linear_layers.0.*`` — the ReLU projection header;
+* ``_projector.weight`` — the bias-free [2, 3D] pair classifier.
+"""
+
+from __future__ import annotations
+
+import json
+import tarfile
+import tempfile
+from pathlib import Path
+from typing import Dict, Tuple, Union
+
+import numpy as np
+
+from ..models.bert import BertConfig
+from ..models.convert import _t, convert_bert_state_dict
+from ..models.memory import MemoryModel
+
+TRANSFORMER_PREFIX = "_text_field_embedder.token_embedder_tokens.transformer_model."
+
+
+def _to_numpy(v) -> np.ndarray:
+    return np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+
+
+def convert_memory_state_dict(
+    state_dict: Dict, config: BertConfig, use_header: bool = True
+) -> Dict:
+    """Reference ``model_memory`` state dict → our full params tree."""
+    sd = {k: _to_numpy(v) for k, v in state_dict.items()}
+
+    transformer_sd = {
+        k[len(TRANSFORMER_PREFIX):]: v
+        for k, v in sd.items()
+        if k.startswith(TRANSFORMER_PREFIX)
+    }
+    if not transformer_sd:
+        raise KeyError(
+            f"no keys under {TRANSFORMER_PREFIX!r} — not a model_memory "
+            "state dict?"
+        )
+    bert_subtree, _ = convert_bert_state_dict(transformer_sd, config)
+
+    params: Dict = {
+        "bert": bert_subtree,
+        "pooler": {
+            "dense": {
+                "kernel": _t(sd["_bert_pooler.pooler.dense.weight"]),
+                "bias": sd["_bert_pooler.pooler.dense.bias"],
+            }
+        },
+        "pair_kernel": _t(sd["_projector.weight"]),
+    }
+    if use_header:
+        params["header"] = {
+            "dense": {
+                "kernel": _t(sd["_projector_single._linear_layers.0.weight"]),
+                "bias": sd["_projector_single._linear_layers.0.bias"],
+            }
+        }
+    return {"params": params}
+
+
+def load_reference_archive(
+    archive_path: Union[str, Path],
+    config: BertConfig,
+) -> Tuple[MemoryModel, Dict, Dict]:
+    """Reference ``model.tar.gz`` → (model, params, stored_config).
+
+    ``config`` supplies the encoder geometry (the reference config names
+    an HF model rather than carrying dims).  Model hyperparameters that
+    the archive's config does carry (``use_header``, ``temperature``) are
+    honored.
+    """
+    archive_path = Path(archive_path)
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = Path(tmp)
+        with tarfile.open(archive_path, "r:gz") as tar:
+            tar.extractall(tmp, filter="data")
+        stored = json.loads((tmp / "config.json").read_text())
+        import torch
+
+        state_dict = torch.load(
+            tmp / "weights.th", map_location="cpu", weights_only=True
+        )
+    model_cfg = stored.get("model") or {}
+    use_header = bool(model_cfg.get("use_header", True))
+    temperature = float(model_cfg.get("temperature", 0.1))
+    header_dim = 512  # reference hardcodes FeedForward(dim, 1, [512], ReLU)
+    model = MemoryModel(
+        config,
+        use_header=use_header,
+        header_dim=header_dim,
+        temperature=temperature,
+    )
+    params = convert_memory_state_dict(state_dict, config, use_header=use_header)
+    return model, params, stored

@@ -1,0 +1,241 @@
+"""Archive-level weight parity: reference-format torch ``model.tar.gz`` →
+convert → ``test_siamese`` → metric equality with a torch reimplementation
+of the reference scoring loop.
+
+In-test we build a tiny torch BertModel + the reference's heads
+(tanh pooler / ReLU FeedForward header / bias-free [2, 3D] projector,
+reference: model_memory.py:63-73), save a reference-shaped archive
+(config.json + weights.th, reference: predict_memory.py:62-67), load it
+through ``memvul_tpu.evaluate.reference_archive``, and score a synthetic
+corpus end-to-end.  The expected numbers come from an independent torch
+implementation of the reference's anchor-match inference
+(model_memory.py:134-147 expand + concat + softmax; predict_memory.py
+:159-197 max-over-anchors + threshold).  Tokenization on the torch side
+uses HF's BertTokenizer over the same vocab.txt, so the whole chain
+(vocab → ids → encoder → heads → metrics) is exercised.
+"""
+
+import json
+import tarfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace, corpus_texts, generate_corpus
+from memvul_tpu.evaluate.measure import cal_metrics
+from memvul_tpu.evaluate.predict_memory import test_siamese as run_siamese_eval
+from memvul_tpu.evaluate.reference_archive import load_reference_archive
+from memvul_tpu.models import BertConfig
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+
+HIDDEN, LAYERS, HEADS, INTER = 64, 2, 4, 128
+HEADER_DIM = 512  # reference hardcodes FeedForward(dim, 1, [512], ReLU)
+MAX_LEN = 64
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("refarc"), seed=21)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(ws, tmp_path_factory):
+    """bert-style vocab.txt trained from the synthetic corpus."""
+    reports, _ = generate_corpus(seed=21)
+    tok = WordPieceTokenizer.train_from_corpus(corpus_texts(reports), vocab_size=1024)
+    vocab = sorted(tok._tok.get_vocab().items(), key=lambda kv: kv[1])
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    path.write_text("\n".join(w for w, _ in vocab) + "\n")
+    return str(path)
+
+
+class TorchMemoryModel(torch.nn.Module):
+    """The reference model_memory's inference-relevant modules with its
+    exact attribute names, so ``state_dict()`` has the archive layout."""
+
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        hf_cfg = transformers.BertConfig(
+            vocab_size=vocab_size,
+            hidden_size=HIDDEN,
+            num_hidden_layers=LAYERS,
+            num_attention_heads=HEADS,
+            intermediate_size=INTER,
+            max_position_embeddings=512,
+        )
+
+        class _Embedder(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.transformer_model = transformers.BertModel(hf_cfg)
+
+        class _Wrapper(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.token_embedder_tokens = _Embedder()
+
+        class _Pooler(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+
+                class _Inner(torch.nn.Module):
+                    def __init__(self):
+                        super().__init__()
+                        self.dense = torch.nn.Linear(HIDDEN, HIDDEN)
+
+                self.pooler = _Inner()
+
+        class _FeedForward(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self._linear_layers = torch.nn.ModuleList(
+                    [torch.nn.Linear(HIDDEN, HEADER_DIM)]
+                )
+
+        self._text_field_embedder = _Wrapper()
+        self._bert_pooler = _Pooler()
+        self._projector_single = _FeedForward()
+        self._projector = torch.nn.Linear(3 * HEADER_DIM, 2, bias=False)
+
+    @torch.no_grad()
+    def encode(self, input_ids, attention_mask):
+        """reference _instance_forward (model_memory.py:90-103)."""
+        bert = self._text_field_embedder.token_embedder_tokens.transformer_model
+        hidden = bert(input_ids=input_ids, attention_mask=attention_mask)
+        cls = hidden.last_hidden_state[:, 0]
+        pooled = torch.tanh(self._bert_pooler.pooler.dense(cls))
+        return torch.relu(self._projector_single._linear_layers[0](pooled))
+
+    @torch.no_grad()
+    def anchor_probs(self, u, bank):
+        """reference anchor match (model_memory.py:134-147): expand both
+        sides, concat [u, v, |u-v|], bias-free linear, softmax."""
+        b, a = u.shape[0], bank.shape[0]
+        uu = u[:, None, :].expand(b, a, u.shape[1])
+        vv = bank[None, :, :].expand(b, a, bank.shape[1])
+        logits = self._projector(torch.cat([uu, vv, torch.abs(uu - vv)], -1))
+        return torch.softmax(logits, dim=-1)[..., 0]  # P(same); same_idx 0
+
+
+def _save_reference_archive(model: TorchMemoryModel, path: Path) -> Path:
+    config = {
+        "model": {
+            "type": "model_memory",
+            "use_header": True,
+            "temperature": 0.1,
+            "PTM": "bert-base-uncased",
+        }
+    }
+    workdir = path.parent / "arc_build"
+    workdir.mkdir(parents=True, exist_ok=True)
+    (workdir / "config.json").write_text(json.dumps(config))
+    torch.save(model.state_dict(), workdir / "weights.th")
+    with tarfile.open(path, "w:gz") as tar:
+        tar.add(workdir / "config.json", arcname="config.json")
+        tar.add(workdir / "weights.th", arcname="weights.th")
+    return path
+
+
+def _torch_reference_scores(model, hf_tok, reader, ws):
+    """The reference scoring flow (predict_memory.py:49-114) in torch:
+    anchor bank first, then stream the test set; per-report per-anchor
+    P(same)."""
+
+    def batch(texts):
+        enc = hf_tok(
+            texts, padding=True, truncation=True, max_length=MAX_LEN,
+            return_tensors="pt",
+        )
+        return enc["input_ids"], enc["attention_mask"]
+
+    anchors = list(reader.read_anchors(ws["paths"]["anchors"]))
+    ids, mask = batch([a["text1"] for a in anchors])
+    bank = model.encode(ids, mask)
+    anchor_labels = [a["meta"]["label"] for a in anchors]
+
+    records = []
+    instances = list(reader.read(ws["paths"]["test"], split="test"))
+    for start in range(0, len(instances), 16):
+        chunk = instances[start : start + 16]
+        ids, mask = batch([i["text1"] for i in chunk])
+        probs = model.anchor_probs(model.encode(ids, mask), bank)
+        for row, inst in zip(probs.numpy(), chunk):
+            records.append(
+                {
+                    "Issue_Url": inst["meta"].get("Issue_Url"),
+                    "label": inst["meta"].get("label"),
+                    "predict": {
+                        lab: float(p) for lab, p in zip(anchor_labels, row)
+                    },
+                }
+            )
+    return records
+
+
+def test_reference_archive_to_metric_parity(ws, vocab_file, tmp_path):
+    tokenizer = WordPieceTokenizer(vocab_path=vocab_file)
+    hf_tok = transformers.BertTokenizer(vocab_file, do_lower_case=True)
+
+    torch.manual_seed(2021)
+    torch_model = TorchMemoryModel(vocab_size=tokenizer.vocab_size)
+    torch_model.eval()
+    archive = _save_reference_archive(torch_model, tmp_path / "model.tar.gz")
+
+    # --- our side: load the torch archive and run the full eval ---------
+    cfg = BertConfig.tiny(
+        vocab_size=tokenizer.vocab_size,
+        hidden_size=HIDDEN,
+        num_layers=LAYERS,
+        num_heads=HEADS,
+        intermediate_size=INTER,
+        max_position_embeddings=512,
+    )
+    model, params, stored = load_reference_archive(archive, cfg)
+    assert stored["model"]["use_header"] is True
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    ours_results = tmp_path / "ours_result.json"
+    metrics = run_siamese_eval(
+        model, params, tokenizer,
+        test_file=ws["paths"]["test"],
+        golden_file=ws["paths"]["anchors"],
+        out_results=ours_results,
+        reader=reader,
+        use_mesh=False,
+        batch_size=16,
+        max_length=MAX_LEN,
+    )
+
+    # --- torch side: independent reimplementation of the scoring loop ---
+    torch_records = _torch_reference_scores(torch_model, hf_tok, reader, ws)
+    torch_results = tmp_path / "torch_result.json"
+    torch_results.write_text(json.dumps(torch_records))
+
+    # per-report per-anchor probability parity
+    ours = {}
+    for line in ours_results.read_text().splitlines():
+        for rec in json.loads(line):
+            ours[rec["Issue_Url"]] = rec
+    assert len(ours) == len(torch_records) > 0
+    for rec in torch_records:
+        mine = ours[rec["Issue_Url"]]
+        assert mine["label"] == rec["label"]
+        for anchor, p in rec["predict"].items():
+            np.testing.assert_allclose(mine["predict"][anchor], p, atol=2e-5)
+
+    # metric-file equality through the same cal_metrics arithmetic
+    m_torch = cal_metrics(torch_results, thres=0.5)
+    m_ours = cal_metrics(ours_results, thres=0.5)
+    for key in ("TP", "FN", "TN", "FP"):
+        assert m_ours[key] == m_torch[key], key
+    for key in ("f1", "prec", "pd&recall", "auc", "ap"):
+        np.testing.assert_allclose(m_ours[key], m_torch[key], atol=1e-6)
+    assert metrics["TP"] == m_torch["TP"]
