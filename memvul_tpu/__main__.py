@@ -160,8 +160,7 @@ def cmd_build_data(args) -> int:
 def cmd_bench(args) -> int:
     from .bench import main as bench_main
 
-    bench_main()
-    return 0
+    return int(bench_main() or 0)
 
 
 def main(argv=None) -> int:

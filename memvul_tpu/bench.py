@@ -27,20 +27,53 @@ Env knobs: BENCH_SEQ_LEN (cap, default 512), BENCH_BUCKETS (comma list,
 default "64,128,256,512"; empty string = pad-everything-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
 BENCH_REPORTS (default 16384).
+
+Supervision. The TPU backend behind the axon tunnel can be transiently
+UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
+number).  ``main`` therefore runs the measurement in a child process with
+a hard per-attempt deadline and retries backend-initialisation failures
+with backoff (BENCH_ATTEMPTS, default 3; BENCH_ATTEMPT_TIMEOUT seconds,
+default 1500).  On unrecoverable failure it still prints exactly one JSON
+line — ``{"metric": ..., "value": 0.0, ..., "error": "..."}`` — never a
+bare traceback, and kills the child's whole process group so no stray
+process is left holding the TPU.
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import tempfile
 import time
 
 BASELINE_RPS_512 = 190.0  # estimated GTX-3090 throughput at seq_len 512 (above)
 
+# Substrings marking a transient backend failure worth retrying (the
+# round-2 capture died with the first one).
+_RETRYABLE_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "Socket closed",
+    "failed to connect",
+)
 
-def main() -> None:
+_CHILD_ENV_FLAG = "MEMVUL_BENCH_CHILD"
+
+
+def _run_bench() -> None:
     import numpy as np
     import jax
+
+    # a sitecustomize hook may pin jax to the TPU plugin (and hang in its
+    # tunnel) even when the environment asks for another platform —
+    # re-assert the env's choice before the first device op (same guard as
+    # __graft_entry__.dryrun_multichip and tests/conftest.py)
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        jax.config.update("jax_platforms", requested)
     import jax.numpy as jnp
 
     from memvul_tpu.data.synthetic import build_workspace
@@ -70,9 +103,16 @@ def main() -> None:
         reports_per_project=max(4, n_reports // 8),
         realistic_lengths=True,
     )
-    cfg = BertConfig.base(
-        vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
-    )
+    # BENCH_MODEL=tiny swaps in the 2-layer test geometry so the FULL
+    # child path (workspace → anchors → bucketed scoring → JSON line) can
+    # be exercised off-TPU in seconds; the recorded number is only
+    # meaningful at the default "base" geometry
+    if os.environ.get("BENCH_MODEL", "base") == "tiny":
+        cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    else:
+        cfg = BertConfig.base(
+            vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
+        )
     model = MemoryModel(cfg)
     dummy = {
         "input_ids": np.zeros((2, 8), np.int32),
@@ -132,6 +172,116 @@ def main() -> None:
             }
         )
     )
+
+
+def _extract_result_line(text: str):
+    """Last stdout line that parses as the bench result dict, else None."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return line
+    return None
+
+
+def _kill_process_group(proc: "subprocess.Popen") -> None:
+    """SIGKILL the child's whole process group — nothing may be left
+    holding the TPU after a timed-out attempt."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+    try:
+        proc.wait(timeout=10)
+    except Exception:
+        pass
+
+
+def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=None):
+    """Run ``cmd`` until it emits a bench-result JSON line.
+
+    Returns (result_line, None) on success or (None, short_error) after the
+    retry budget is exhausted.  Only transient backend failures (markers
+    above) and deadline kills are retried; a genuine bug fails fast.
+    """
+    last_error = "no attempts were made"
+    for attempt in range(1, attempts + 1):
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            start_new_session=True,  # own process group, killable as a unit
+        )
+        try:
+            out, err = proc.communicate(timeout=attempt_timeout)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            _kill_process_group(proc)
+            # harvest whatever the child wrote before hanging — a result
+            # line printed before a teardown hang is still a result
+            try:
+                out, err = proc.communicate(timeout=10)
+            except Exception:
+                out, err = "", ""
+            out, err, timed_out = out or "", err or "", True
+
+        line = _extract_result_line(out)
+        if line is not None:
+            return line, None
+        if not timed_out and proc.returncode == 0:
+            # deterministic bug (result contract broken): fail fast
+            return None, "child exited 0 without a result line"
+        if timed_out:
+            last_error = f"attempt timed out after {attempt_timeout:.0f}s"
+        else:
+            tail = ((err or "") + (out or "")).strip().splitlines()
+            last_error = tail[-1][:300] if tail else f"rc={proc.returncode}"
+            if not any(m in (err + out) for m in _RETRYABLE_MARKERS):
+                return None, last_error  # not transient: don't burn retries
+
+        if attempt < attempts:
+            sys.stderr.write(
+                f"bench attempt {attempt}/{attempts} failed ({last_error}); "
+                f"retrying in {backoff * attempt:.0f}s\n"
+            )
+            time.sleep(backoff * attempt)
+    return None, last_error
+
+
+def main() -> int:
+    if os.environ.get(_CHILD_ENV_FLAG) == "1":
+        _run_bench()
+        return 0
+
+    attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "3")))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1500"))
+    backoff = float(os.environ.get("BENCH_BACKOFF", "20"))
+
+    cmd = [sys.executable, "-m", "memvul_tpu.bench"]
+    child_env = dict(os.environ, **{_CHILD_ENV_FLAG: "1"})
+    line, error = _supervise(cmd, attempts, attempt_timeout, backoff, env=child_env)
+    if line is not None:
+        print(line)
+        return 0
+    print(
+        json.dumps(
+            {
+                "metric": "siamese_scoring_throughput",
+                "value": 0.0,
+                "unit": "reports/sec",
+                "vs_baseline": 0.0,
+                "error": error,
+            }
+        )
+    )
+    return 1
 
 
 if __name__ == "__main__":

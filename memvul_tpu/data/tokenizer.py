@@ -102,6 +102,22 @@ class WordPieceTokenizer(TextTokenizer):
                 )
             self._tok = _bert_tokenizer_from_vocab(str(vocab_path), lowercase)
         elif tokenizer_path is not None:
+            if vocab_path is not None:
+                # the config NAMES the real BERT vocabulary but the file is
+                # absent — the trained tokenizer is a functional substitute
+                # but tokenizes differently from bert-base-uncased, so F1
+                # parity with reference checkpoints is structurally
+                # impossible until the genuine vocab.txt is dropped in
+                logging.getLogger(__name__).warning(
+                    "tokenizer: config names vocab_path=%s but that file "
+                    "does NOT exist — falling back to the locally-trained "
+                    "tokenizer %s. Tokenization will NOT match "
+                    "bert-base-uncased; reference-checkpoint parity needs "
+                    "the real vocab file (see README: 'Using the real BERT "
+                    "vocabulary').",
+                    vocab_path,
+                    tokenizer_path,
+                )
             self._tok = _FastTokenizer.from_file(str(tokenizer_path))
         elif vocab_path is not None:
             self._tok = _bert_tokenizer_from_vocab(str(vocab_path), lowercase)

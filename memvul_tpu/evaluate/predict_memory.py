@@ -169,27 +169,82 @@ class SiamesePredictor:
         split: Optional[str] = None,
     ) -> Dict[str, float]:
         """Stream a corpus file, write the reference-format result lines,
-        return the threshold-swept siamese metrics."""
+        return the threshold-swept siamese metrics.
+
+        Serialization (one ~129-float dict per report → JSON) runs on a
+        dedicated writer thread: at corpus-scale throughput that is
+        hundreds of thousands of float-to-text conversions per second,
+        which would otherwise sit on the same thread that syncs device
+        results and starve the dispatch pipeline."""
+        import queue
+        import threading
+
         measure = SiameseMeasure()
         n = 0
         start = time.perf_counter()
-        with open(out_path, "w") as f:
-            for probs, metas in self.score_instances(reader.read(str(test_path), split=split)):
-                records = []
-                for row, meta in zip(probs, metas):
-                    records.append(
-                        {
-                            "Issue_Url": meta.get("Issue_Url"),
-                            "label": meta.get("label"),
-                            "predict": {
-                                anchor: float(p)
-                                for anchor, p in zip(self.anchor_labels, row)
-                            },
-                        }
-                    )
+        q: "queue.Queue" = queue.Queue(maxsize=16)
+        writer_error: List[BaseException] = []
+        failed = threading.Event()
+
+        def _writer() -> None:
+            try:
+                with open(out_path, "w") as f:
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        probs, metas = item
+                        records = [
+                            {
+                                "Issue_Url": meta.get("Issue_Url"),
+                                "label": meta.get("label"),
+                                "predict": {
+                                    anchor: float(p)
+                                    for anchor, p in zip(self.anchor_labels, row)
+                                },
+                            }
+                            for row, meta in zip(probs, metas)
+                        ]
+                        f.write(json.dumps(records) + "\n")
+            except BaseException as e:  # propagated to the caller below
+                writer_error.append(e)
+                failed.set()
+
+        writer = threading.Thread(target=_writer, daemon=True)
+        writer.start()
+        try:
+            for probs, metas in self.score_instances(
+                reader.read(str(test_path), split=split)
+            ):
+                while not failed.is_set():
+                    try:
+                        q.put((probs, metas), timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+                if failed.is_set():
+                    break
                 measure.update(probs.max(axis=-1), metas)
-                n += len(records)
-                f.write(json.dumps(records) + "\n")
+                n += len(metas)
+        finally:
+            # signal end-of-stream with the same failure-aware loop as the
+            # data puts: the writer may die (and stop consuming) at any
+            # moment, so an unconditional blocking put could deadlock
+            while True:
+                if failed.is_set():
+                    try:
+                        while True:
+                            q.get_nowait()
+                    except queue.Empty:
+                        pass
+                try:
+                    q.put(None, timeout=1.0)
+                    break
+                except queue.Full:
+                    continue
+            writer.join()
+        if writer_error:
+            raise writer_error[0]
         elapsed = time.perf_counter() - start
         logger.info(
             "scored %d reports in %.1fs (%.0f reports/s)", n, elapsed, n / max(elapsed, 1e-9)

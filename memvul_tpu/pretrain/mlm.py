@@ -27,6 +27,7 @@ import numpy as np
 from flax import linen as nn
 
 from ..models.bert import BertConfig, BertEncoder, _dense_init
+from ..training.metrics import drain_pending
 
 logger = logging.getLogger(__name__)
 
@@ -192,6 +193,11 @@ class MLMTrainerConfig:
     steps_per_epoch: Optional[int] = None
     output_dir: Optional[str] = None  # enables checkpoint/resume
     overwrite_output_dir: bool = False  # reference: run_mlm_wwm.py:190-196
+    # steps allowed in flight before losses are pulled to the host (the
+    # NaN guard fires in the pulled block); 1 = sync per step
+    sync_every: int = 32
+    # host batches prepared ahead of the device (masking off critical path)
+    prefetch_depth: int = 4
 
 
 class MLMTrainer:
@@ -230,10 +236,11 @@ class MLMTrainer:
         if self.c.output_dir is not None:
             self._init_output_dir()
 
-        def train_step(params, opt_state, stack_ids, stack_mask, stack_labels, rng):
+        def train_step(params, opt_state, rng, stack_ids, stack_mask, stack_labels):
             """One optimizer update over a [K, B, L] microbatch stack —
             the reference's batch 16 × accum 2 schedule made real via the
-            same lax.scan pattern as training/trainer.py:make_train_step."""
+            same lax.scan pattern as training/trainer.py:make_train_step.
+            The RNG advances on device so the host loop is dispatch-only."""
 
             def loss_fn(p, ids, mask, labels, sub):
                 logits = self.model.apply(
@@ -255,7 +262,7 @@ class MLMTrainer:
                 return (grads_sum, loss_sum + loss, real_sum + real, rng), None
 
             zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (grads, loss_sum, real_k, _), _ = jax.lax.scan(
+            (grads, loss_sum, real_k, rng), _ = jax.lax.scan(
                 accumulate,
                 (zero, 0.0, 0.0, rng),
                 (stack_ids, stack_mask, stack_labels),
@@ -266,9 +273,9 @@ class MLMTrainer:
             params = jax.tree_util.tree_map(
                 lambda p, u: p + u.astype(p.dtype), params, updates
             )
-            return params, opt_state, loss_sum / real_k
+            return params, opt_state, rng, loss_sum / real_k
 
-        self._train_step = jax.jit(train_step)
+        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     # -- checkpoint / resume --------------------------------------------------
 
@@ -317,19 +324,51 @@ class MLMTrainer:
 
     # -- data ------------------------------------------------------------------
 
-    def _batches(self, lines: List[str]) -> Iterator[Tuple[np.ndarray, ...]]:
-        """[K, B, L] microbatch stacks (K = grad_accum).  The trailing
-        partial stack is padded with empty rows — pad-only rows yield no
-        maskable positions, so they contribute no loss."""
+    def _encode_corpus(self, lines: List[str]) -> None:
+        """Tokenize the whole corpus ONCE into a packed (flat ids, offsets)
+        int32 pair; every epoch afterwards only shuffles indices and masks.
+        The reference gets the same once-only property from datasets.map
+        with worker processes (run_mlm_wwm.py:322-333); at 1.1M lines × 50
+        epochs, per-epoch re-tokenization would dominate the pipeline."""
         c = self.c
+        started = time.perf_counter()
+        chunks: List[np.ndarray] = []
+        offsets = np.zeros(len(lines) + 1, dtype=np.int64)
+        for i, text in enumerate(lines):
+            seq = np.asarray(
+                self.tokenizer.encode(text, max_length=c.max_length), np.int32
+            )
+            chunks.append(seq)
+            offsets[i + 1] = offsets[i] + len(seq)
+        self._flat_ids = (
+            np.concatenate(chunks) if chunks else np.zeros(0, np.int32)
+        )
+        self._offsets = offsets
+        logger.info(
+            "mlm: tokenized %d lines (%d tokens) in %.1fs — cached for all "
+            "epochs", len(lines), len(self._flat_ids),
+            time.perf_counter() - started,
+        )
+
+    @property
+    def corpus_size(self) -> int:
+        return len(self._offsets) - 1 if hasattr(self, "_offsets") else 0
+
+    def _batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        """[K, B, L] microbatch stacks (K = grad_accum) from the packed
+        token cache.  The trailing partial stack is padded with empty
+        rows — pad-only rows yield no maskable positions, so they
+        contribute no loss."""
+        c = self.c
+        n = self.corpus_size
         rows = c.batch_size * max(1, c.grad_accum)
-        order = self._np_rng.permutation(len(lines))
-        for start in range(0, len(lines), rows):
-            texts = [lines[i] for i in order[start : start + rows]]
+        order = self._np_rng.permutation(n)
+        for start in range(0, n, rows):
+            picked = order[start : start + rows]
             ids = np.full((rows, c.max_length), self.tokenizer.pad_id, np.int32)
             mask = np.zeros_like(ids)
-            for i, t in enumerate(texts):
-                seq = self.tokenizer.encode(t, max_length=c.max_length)
+            for i, idx in enumerate(picked):
+                seq = self._flat_ids[self._offsets[idx] : self._offsets[idx + 1]]
                 ids[i, : len(seq)] = seq
                 mask[i, : len(seq)] = 1
             masked, labels = whole_word_mask(
@@ -341,6 +380,8 @@ class MLMTrainer:
             yield masked.reshape(shape), mask.reshape(shape), labels.reshape(shape)
 
     def train(self, corpus_path: str) -> Dict[str, float]:
+        from ..data.batching import prefetch
+
         c = self.c
         lines = [
             l.strip() for l in open(corpus_path, encoding="utf-8") if l.strip()
@@ -348,22 +389,34 @@ class MLMTrainer:
         if not lines:
             raise ValueError(f"MLM corpus {corpus_path} is empty")
         logger.info("MLM corpus: %d lines", len(lines))
+        self._encode_corpus(lines)
         self.maybe_restore()
         rng = jax.random.PRNGKey(c.seed)
         rng = jax.random.fold_in(rng, self.start_epoch)  # distinct post-resume
         history: List[float] = []
         for epoch in range(self.start_epoch, c.num_epochs):
-            losses = []
+            losses: List[float] = []
+            pending: List[jax.Array] = []
             started = time.perf_counter()
-            for i, (ids, mask, labels) in enumerate(self._batches(lines)):
+
+            def drain() -> None:
+                # the loop's only blocking transfer; NaN guard lives here
+                drain_pending(
+                    pending, jax.device_get, self.step, losses, what="MLM loss"
+                )
+
+            batches = prefetch(self._batches(), depth=max(1, c.prefetch_depth))
+            for i, (ids, mask, labels) in enumerate(batches):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
-                rng, sub = jax.random.split(rng)
-                self.params, self.opt_state, loss = self._train_step(
-                    self.params, self.opt_state, ids, mask, labels, sub
+                self.params, self.opt_state, rng, loss = self._train_step(
+                    self.params, self.opt_state, rng, ids, mask, labels
                 )
-                losses.append(float(loss))
+                pending.append(loss)
                 self.step += 1
+                if len(pending) >= max(1, c.sync_every):
+                    drain()
+            drain()
             mean_loss = float(np.mean(losses)) if losses else 0.0
             history.append(mean_loss)
             logger.info(

@@ -23,6 +23,46 @@ import numpy as np
 from sklearn import metrics as _skm
 
 
+def device_confusion(logits, labels, weights):
+    """[C, C] weighted confusion counts (rows = true label), computed ON
+    DEVICE inside a jitted train step — the per-step metrics travel back
+    to the host as C² ints instead of full logits.  Shared by all three
+    trainers so the stats contract can't diverge."""
+    import jax.numpy as jnp
+
+    n_classes = logits.shape[-1]
+    preds = logits.argmax(axis=-1).reshape(-1)
+    labels = labels.reshape(-1)
+    keep = (weights.reshape(-1) > 0).astype(jnp.int32)
+    return jnp.zeros((n_classes, n_classes), jnp.int32).at[labels, preds].add(keep)
+
+
+def drain_pending(
+    pending: List,
+    fetch,
+    current_step: int,
+    losses: List[float],
+    running: Optional["RunningClassification"] = None,
+    what: str = "loss",
+) -> None:
+    """Pull a window of in-flight per-step stats to the host in ONE
+    transfer (the epoch loops' only blocking point) and fold them into
+    host accumulators.  The NaN guard fires here, attributed to the
+    absolute step index.  ``pending`` entries are either stats dicts
+    ({"loss", "confusion"}) or bare loss scalars."""
+    if not pending:
+        return
+    first_step = current_step - len(pending)
+    for offset, stats in enumerate(fetch(pending)):
+        loss = float(stats["loss"]) if isinstance(stats, dict) else float(stats)
+        if np.isnan(loss):
+            raise FloatingPointError(f"NaN {what} at step {first_step + offset}")
+        losses.append(loss)
+        if running is not None and isinstance(stats, dict):
+            running.update_confusion(stats["confusion"])
+    pending.clear()
+
+
 def binary_confusion(
     labels: Sequence[int], preds: Sequence[int]
 ) -> Tuple[int, int, int, int]:
@@ -155,6 +195,11 @@ class RunningClassification:
         )
         for p, l in zip(preds[keep], labels[keep]):
             self._cm[l, p] += 1
+
+    def update_confusion(self, confusion) -> None:
+        """Merge a pre-computed [C, C] count matrix (rows = true label,
+        cols = prediction) — the shape the device-side train step emits."""
+        self._cm += np.asarray(confusion, dtype=np.int64)
 
     def compute(self, reset: bool = False) -> Dict[str, float]:
         cm = self._cm

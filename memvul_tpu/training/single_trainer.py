@@ -26,14 +26,21 @@ from ..data.readers import DatasetReader
 from ..models.losses import masked_cross_entropy
 from ..parallel.mesh import replicate, shard_batch
 from .checkpoint import MetricTracker, TrainCheckpointer
-from .metrics import RunningClassification
+from .metrics import RunningClassification, device_confusion, drain_pending
 from .optim import make_optimizer
 
 logger = logging.getLogger(__name__)
 
+# blocking device→host pulls route through this alias so tests can count
+# them (same contract as training/trainer.py)
+_host_fetch = jax.device_get
+
 
 def make_classifier_step(model, tx):
-    """One CE optimizer step over a single padded batch."""
+    """One CE optimizer step over a single padded batch.  The RNG advances
+    on device and per-step metrics come back as a tiny stats dict (mean
+    loss + weighted confusion counts) so the epoch loop never blocks on a
+    per-step transfer."""
 
     def loss_fn(params, batch, rng):
         logits = model.apply(
@@ -44,15 +51,22 @@ def make_classifier_step(model, tx):
         )
         return loss, logits
 
-    def step(params, opt_state, batch, rng):
+    def step(params, opt_state, rng, batch):
+        rng, sub = jax.random.split(rng)
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch, rng
+            params, batch, sub
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(
             lambda p, u: p + u.astype(p.dtype), params, updates
         )
-        return params, opt_state, loss, logits
+        stats = {
+            "loss": loss,
+            "confusion": device_confusion(
+                logits, batch["label"], batch["weight"]
+            ),
+        }
+        return params, opt_state, rng, stats
 
     return step
 
@@ -76,6 +90,9 @@ class ClassifierTrainerConfig:
     serialization_dir: Optional[str] = None
     keep_checkpoints: int = 1
     steps_per_epoch: Optional[int] = None
+    # steps allowed in flight before the accumulated stats are pulled to
+    # the host (NaN guard fires in the pulled block); 1 = sync per step
+    sync_every: int = 32
 
 
 class ClassifierTrainer:
@@ -127,7 +144,10 @@ class ClassifierTrainer:
             else None
         )
         self.metrics_history: List[Dict[str, Any]] = []
-        self._step_fn = jax.jit(make_classifier_step(self.model, self.tx))
+        self._step_fn = jax.jit(
+            make_classifier_step(self.model, self.tx),
+            donate_argnums=(0, 1, 2),
+        )
 
     # -- data ----------------------------------------------------------------
 
@@ -154,26 +174,27 @@ class ClassifierTrainer:
 
         running = RunningClassification(2, ["neg", "pos"])
         losses: List[float] = []
+        pending: List[Dict] = []
         timer = StepTimer()
         started = time.perf_counter()
+
+        def drain() -> None:
+            # the loop's only blocking transfer; NaN guard lives here
+            drain_pending(pending, _host_fetch, self.step, losses, running)
+
         for i, batch in enumerate(self._batches()):
             if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                 break
-            self.rng, step_rng = jax.random.split(self.rng)
             with timer.step():
-                self.params, self.opt_state, loss, logits = self._step_fn(
-                    self.params, self.opt_state, batch, step_rng
+                self.params, self.opt_state, self.rng, stats = self._step_fn(
+                    self.params, self.opt_state, self.rng, batch
                 )
-                loss = float(loss)
-            if np.isnan(loss):
-                raise FloatingPointError(f"NaN loss at step {self.step}")
-            losses.append(loss)
-            running.update(
-                np.asarray(logits.argmax(axis=-1)).reshape(-1),
-                np.asarray(batch["label"]).reshape(-1),
-                np.asarray(batch["weight"]).reshape(-1),
-            )
-            self.step += 1
+                pending.append(stats)
+                self.step += 1
+                if len(pending) >= max(1, c.sync_every):
+                    drain()
+        with timer.attribute_to_last():  # tail window's device work
+            drain()
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started

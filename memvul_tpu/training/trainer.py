@@ -40,17 +40,34 @@ from ..data.readers import MemoryReader
 from ..models.memory import MemoryModel, pair_loss
 from ..parallel.mesh import replicate, shard_batch
 from .checkpoint import MetricTracker, TrainCheckpointer
-from .metrics import RunningClassification
+from .metrics import RunningClassification, device_confusion, drain_pending
 from .optim import make_optimizer
 
 logger = logging.getLogger(__name__)
 
+# every blocking device→host pull in the epoch loop goes through this
+# alias so tests can count transfers (proving the loop runs ahead of the
+# device rather than syncing per step)
+_host_fetch = jax.device_get
 
-def make_train_step(model: MemoryModel, tx):
+
+def make_train_step(model: MemoryModel, tx, ema_decay: Optional[float] = None):
     """Build the fused optimizer step: grad accumulation over a [K, B, ...]
     microbatch stack via ``lax.scan``, then one parameter-group AdamW
     update.  Shared by :class:`MemoryTrainer` and the driver's multi-chip
-    dryrun so both compile the same program."""
+    dryrun so both compile the same program.
+
+    Everything the host needs per step is folded into the one program so
+    the epoch loop never blocks on a transfer (the reference host-syncs
+    every step — custom_trainer.py:398-435): the RNG advances on device,
+    the EMA update (when ``ema_decay`` is set) rides the same dispatch,
+    and per-step metrics come back as a tiny ``stats`` dict — mean loss
+    plus a weighted 2×2 confusion-count matrix — instead of full logits.
+
+    Signature: ``step(params, opt_state, rng, stack) ->
+    (params, opt_state, rng, stats)``; with EMA an ``ema`` pytree is
+    threaded in before ``stack`` and returned before ``stats``.
+    """
     temperature = model.temperature
 
     def loss_fn(params, microbatch, rng):
@@ -66,7 +83,7 @@ def make_train_step(model: MemoryModel, tx):
         )
         return loss, logits
 
-    def train_step(params, opt_state, stack, rng):
+    def _core(params, opt_state, rng, stack):
         def accumulate(carry, microbatch):
             grads_sum, loss_sum, rng = carry
             rng, sub = jax.random.split(rng)
@@ -77,7 +94,7 @@ def make_train_step(model: MemoryModel, tx):
             return (grads_sum, loss_sum + loss, rng), logits
 
         zero_grads = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p), params)
-        (grads, loss_sum, _), logits = jax.lax.scan(
+        (grads, loss_sum, rng), logits = jax.lax.scan(
             accumulate, (zero_grads, 0.0, rng), stack
         )
         k = stack["label"].shape[0]
@@ -86,7 +103,26 @@ def make_train_step(model: MemoryModel, tx):
         params = jax.tree_util.tree_map(
             lambda p, u: p + u.astype(p.dtype), params, updates
         )
-        return params, opt_state, loss_sum / k, logits
+        stats = {
+            "loss": loss_sum / k,
+            "confusion": device_confusion(
+                logits, stack["label"], stack["weight"]
+            ),
+        }
+        return params, opt_state, rng, stats
+
+    if ema_decay is None:
+        return _core
+
+    decay = float(ema_decay)
+
+    def train_step(params, opt_state, rng, ema, stack):
+        params, opt_state, rng, stats = _core(params, opt_state, rng, stack)
+        ema = jax.tree_util.tree_map(
+            lambda e, x: e * decay + x.astype(e.dtype) * (1.0 - decay),
+            ema, params,
+        )
+        return params, opt_state, rng, ema, stats
 
     return train_step
 
@@ -121,6 +157,10 @@ class TrainerConfig:
     # averaged weights (the reference's moving_average support,
     # custom_trainer.py:437-439,514-516)
     ema_decay: Optional[float] = None
+    # how many steps to let run ahead before pulling the accumulated
+    # per-step stats (loss + confusion counts) to the host; the NaN guard
+    # fires inside the pulled block.  1 restores step-synchronous behavior.
+    sync_every: int = 32
 
 
 class MemoryTrainer:
@@ -177,17 +217,16 @@ class MemoryTrainer:
             else None
         )
         self.metrics_history: List[Dict[str, Any]] = []
-        self._train_step = jax.jit(make_train_step(self.model, self.tx))
         self.ema_params = None
         if c.ema_decay is not None:
-            decay = float(c.ema_decay)
             self.ema_params = jax.tree_util.tree_map(jnp.copy, self.params)
-            self._ema_update = jax.jit(
-                lambda ema, p: jax.tree_util.tree_map(
-                    lambda e, x: e * decay + x.astype(e.dtype) * (1.0 - decay),
-                    ema, p,
-                )
-            )
+        # EMA rides inside the one jitted step (no second dispatch); input
+        # state buffers are donated so base-geometry params/opt-state don't
+        # double-buffer in HBM
+        self._train_step = jax.jit(
+            make_train_step(self.model, self.tx, ema_decay=c.ema_decay),
+            donate_argnums=(0, 1, 2, 3) if c.ema_decay is not None else (0, 1, 2),
+        )
 
     # -- data ----------------------------------------------------------------
 
@@ -240,12 +279,18 @@ class MemoryTrainer:
 
     # -- epoch orchestration ---------------------------------------------------
 
+    def _drain_stats(self, pending, running, losses) -> None:
+        """One blocking transfer per window; NaN guard fires here
+        (reference NaN check: custom_trainer.py:403-404)."""
+        drain_pending(pending, _host_fetch, self.step, losses, running)
+
     def train_epoch(self) -> Dict[str, float]:
         c = self.config
         from ..utils.profiling import StepTimer, device_memory_stats, trace_context
 
         running = RunningClassification(2, ["same", "diff"])
         losses: List[float] = []
+        pending: List[Dict] = []
         timer = StepTimer()
         started = time.perf_counter()
         trace_dir = c.profile_dir if (c.profile_dir and self.epoch == 0) else None
@@ -253,22 +298,27 @@ class MemoryTrainer:
             for i, stack in enumerate(self._microbatch_stacks()):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
-                self.rng, step_rng = jax.random.split(self.rng)
                 with timer.step():
-                    self.params, self.opt_state, loss, logits = self._train_step(
-                        self.params, self.opt_state, stack, step_rng
-                    )
-                    loss = float(loss)
-                if np.isnan(loss):
-                    raise FloatingPointError(f"NaN loss at step {self.step}")
-                losses.append(loss)
-                if self.ema_params is not None:
-                    self.ema_params = self._ema_update(self.ema_params, self.params)
-                preds = np.asarray(logits.argmax(axis=-1)).reshape(-1)
-                labels = np.asarray(stack["label"]).reshape(-1)
-                weights = np.asarray(stack["weight"]).reshape(-1)
-                running.update(preds, labels, weights)
-                self.step += 1
+                    if self.ema_params is not None:
+                        (
+                            self.params, self.opt_state, self.rng,
+                            self.ema_params, stats,
+                        ) = self._train_step(
+                            self.params, self.opt_state, self.rng,
+                            self.ema_params, stack,
+                        )
+                    else:
+                        self.params, self.opt_state, self.rng, stats = (
+                            self._train_step(
+                                self.params, self.opt_state, self.rng, stack
+                            )
+                        )
+                    pending.append(stats)
+                    self.step += 1
+                    if len(pending) >= max(1, c.sync_every):
+                        self._drain_stats(pending, running, losses)
+            with timer.attribute_to_last():  # tail window's device work
+                self._drain_stats(pending, running, losses)
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started

@@ -46,6 +46,21 @@ class StepTimer:
         finally:
             self._durations.append(time.perf_counter() - start)
 
+    @contextlib.contextmanager
+    def attribute_to_last(self) -> Iterator[None]:
+        """Fold the block's elapsed time into the LAST recorded step
+        instead of counting a new one — used for the tail stats-drain,
+        whose wait is device work belonging to the steps already issued."""
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            if self._durations:
+                self._durations[-1] += elapsed
+            elif elapsed > 0:
+                self._durations.append(elapsed)
+
     def __len__(self) -> int:
         return len(self._durations)
 

@@ -1,0 +1,120 @@
+"""Tests for the bench supervision harness (memvul_tpu/bench.py).
+
+The round-2 driver capture died with a transient 'Unable to initialize
+backend axon: UNAVAILABLE' at the first device op; the supervisor must
+retry that class of failure, kill hung attempts by process group, and
+emit exactly one JSON line on unrecoverable failure (never a traceback).
+No JAX is involved here — the children are tiny shell-level scripts.
+"""
+
+import json
+import sys
+
+from memvul_tpu.bench import _extract_result_line, _supervise
+
+RESULT = '{"metric": "siamese_scoring_throughput", "value": 1.0, "unit": "reports/sec", "vs_baseline": 1.0}'
+
+
+def _script_cmd(body: str):
+    return [sys.executable, "-c", body]
+
+
+def test_extract_result_line_picks_last_json_dict():
+    text = "warning noise\n{not json\n" + RESULT + "\ntrailing"
+    line = _extract_result_line(text)
+    assert json.loads(line)["metric"] == "siamese_scoring_throughput"
+    assert _extract_result_line("no json here") is None
+    # a JSON line without 'metric' is not a result
+    assert _extract_result_line('{"foo": 1}') is None
+
+
+def test_supervise_success_first_try():
+    cmd = _script_cmd(f"print('{RESULT}')")
+    line, err = _supervise(cmd, attempts=1, attempt_timeout=30, backoff=0)
+    assert err is None
+    assert json.loads(line)["vs_baseline"] == 1.0
+
+
+def test_supervise_retries_unavailable_then_succeeds(tmp_path):
+    flag = tmp_path / "failed_once"
+    body = (
+        "import os, sys\n"
+        f"flag = {str(flag)!r}\n"
+        "if not os.path.exists(flag):\n"
+        "    open(flag, 'w').close()\n"
+        "    sys.stderr.write('RuntimeError: Unable to initialize backend "
+        "axon: UNAVAILABLE: TPU backend setup/compile error\\n')\n"
+        "    sys.exit(1)\n"
+        f"print('{RESULT}')\n"
+    )
+    line, err = _supervise(
+        _script_cmd(body), attempts=3, attempt_timeout=30, backoff=0
+    )
+    assert err is None
+    assert json.loads(line)["value"] == 1.0
+    assert flag.exists()
+
+
+def test_supervise_non_retryable_fails_fast(tmp_path):
+    counter = tmp_path / "runs"
+    body = (
+        "import sys\n"
+        f"open({str(counter)!r}, 'a').write('x')\n"
+        "sys.stderr.write('ValueError: genuine bug\\n')\n"
+        "sys.exit(1)\n"
+    )
+    line, err = _supervise(
+        _script_cmd(body), attempts=3, attempt_timeout=30, backoff=0
+    )
+    assert line is None
+    assert "genuine bug" in err
+    # a non-transient failure must not burn the retry budget
+    assert counter.read_text() == "x"
+
+
+def test_supervise_kills_hung_attempt_and_reports_timeout():
+    body = "import time\ntime.sleep(60)\n"
+    line, err = _supervise(
+        _script_cmd(body), attempts=2, attempt_timeout=1, backoff=0
+    )
+    assert line is None
+    assert "timed out" in err
+
+
+def test_result_printed_before_hang_is_harvested():
+    """A child that prints the result and THEN hangs (e.g. a teardown
+    hang in the axon tunnel) still counts as a successful measurement."""
+    body = (
+        f"print('{RESULT}', flush=True)\n"
+        "import time\n"
+        "time.sleep(120)\n"
+    )
+    line, err = _supervise(
+        _script_cmd(body), attempts=1, attempt_timeout=15, backoff=0
+    )
+    assert err is None
+    assert json.loads(line)["value"] == 1.0
+
+
+def test_zero_exit_without_result_fails_fast(tmp_path):
+    counter = tmp_path / "runs"
+    body = f"open({str(counter)!r}, 'a').write('x')\nprint('no result here')\n"
+    line, err = _supervise(
+        _script_cmd(body), attempts=3, attempt_timeout=30, backoff=0
+    )
+    assert line is None
+    assert "without a result line" in err
+    assert counter.read_text() == "x"  # no retries burned
+
+
+def test_exhausted_retries_report_last_error(tmp_path):
+    body = (
+        "import sys\n"
+        "sys.stderr.write('UNAVAILABLE: still down\\n')\n"
+        "sys.exit(1)\n"
+    )
+    line, err = _supervise(
+        _script_cmd(body), attempts=2, attempt_timeout=30, backoff=0
+    )
+    assert line is None
+    assert "UNAVAILABLE" in err

@@ -115,3 +115,79 @@ def test_converter_shape_mismatch_raises(hf_bert):
     params = model.init(jax.random.PRNGKey(0), d, d)
     with pytest.raises((ValueError, KeyError)):
         load_into_classifier(params, hf_bert.state_dict(), small_cfg)
+
+
+def _synthetic_bert_state_dict(
+    vocab=30522, hidden=768, layers=12, heads=12, intermediate=3072, max_pos=512
+):
+    """A bert-base-uncased-shaped state dict (HF BertModel key layout) with
+    zero weights — shape/name-level only, no forward needed."""
+    sd = {
+        "embeddings.word_embeddings.weight": np.zeros((vocab, hidden), np.float32),
+        "embeddings.position_embeddings.weight": np.zeros((max_pos, hidden), np.float32),
+        "embeddings.token_type_embeddings.weight": np.zeros((2, hidden), np.float32),
+        "embeddings.LayerNorm.weight": np.zeros(hidden, np.float32),
+        "embeddings.LayerNorm.bias": np.zeros(hidden, np.float32),
+        "pooler.dense.weight": np.zeros((hidden, hidden), np.float32),
+        "pooler.dense.bias": np.zeros(hidden, np.float32),
+    }
+    for i in range(layers):
+        p = f"encoder.layer.{i}."
+        for name in ("query", "key", "value"):
+            sd[p + f"attention.self.{name}.weight"] = np.zeros((hidden, hidden), np.float32)
+            sd[p + f"attention.self.{name}.bias"] = np.zeros(hidden, np.float32)
+        sd[p + "attention.output.dense.weight"] = np.zeros((hidden, hidden), np.float32)
+        sd[p + "attention.output.dense.bias"] = np.zeros(hidden, np.float32)
+        sd[p + "attention.output.LayerNorm.weight"] = np.zeros(hidden, np.float32)
+        sd[p + "attention.output.LayerNorm.bias"] = np.zeros(hidden, np.float32)
+        sd[p + "intermediate.dense.weight"] = np.zeros((intermediate, hidden), np.float32)
+        sd[p + "intermediate.dense.bias"] = np.zeros(intermediate, np.float32)
+        sd[p + "output.dense.weight"] = np.zeros((hidden, intermediate), np.float32)
+        sd[p + "output.dense.bias"] = np.zeros(hidden, np.float32)
+        sd[p + "output.LayerNorm.weight"] = np.zeros(hidden, np.float32)
+        sd[p + "output.LayerNorm.bias"] = np.zeros(hidden, np.float32)
+    return sd
+
+
+def test_base_geometry_conversion_shapes():
+    """A bert-base-sized reference state dict must convert into the
+    scan-stacked param tree name-for-name and shape-for-shape, with NO
+    forward pass (jax.eval_shape gives the expected tree for free) —
+    catches weights.th name/shape drift at the real 12-layer geometry
+    (reference layout: model_memory.py:63-73)."""
+    cfg = BertConfig.base(vocab_size=30522, scan_layers=True)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": jax.ShapeDtypeStruct((2, 8), np.int32),
+        "attention_mask": jax.ShapeDtypeStruct((2, 8), np.int32),
+    }
+    expected = jax.eval_shape(model.init, jax.random.PRNGKey(0), dummy, dummy)
+    bert_subtree, pooler = convert_bert_state_dict(
+        _synthetic_bert_state_dict(), cfg
+    )
+    converted_flat = {
+        jax.tree_util.keystr(path): leaf.shape
+        for path, leaf in jax.tree_util.tree_leaves_with_path(bert_subtree)
+    }
+    expected_flat = {
+        jax.tree_util.keystr(path): leaf.shape
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            expected["params"]["bert"]
+        )
+    }
+    assert converted_flat == expected_flat
+    # scan stacking puts the 12-layer axis in front
+    q = bert_subtree["encoder"]["layers"]["layer"]["attention"]["query"]["kernel"]
+    assert q.shape == (12, 768, 12, 64)
+    # pooler converts too
+    pooler_flat = {
+        jax.tree_util.keystr(path): leaf.shape
+        for path, leaf in jax.tree_util.tree_leaves_with_path(pooler)
+    }
+    expected_pooler = {
+        jax.tree_util.keystr(path): leaf.shape
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+            expected["params"]["pooler"]
+        )
+    }
+    assert pooler_flat == expected_pooler

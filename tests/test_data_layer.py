@@ -69,6 +69,34 @@ def test_tag_tokens_atomic(workspace):
     assert len(ids) == 3  # CLS + tag + SEP
 
 
+def test_missing_named_vocab_warns_loudly(workspace, tmp_path, caplog):
+    """A config naming a vocab_path that doesn't exist must WARN that the
+    trained (non-parity) tokenizer is in use — reference tokenization is
+    bert-base-uncased (MemVul/config_memory.json:16-20) and silently
+    substituting a different vocab makes F1 parity impossible."""
+    import logging
+
+    p = tmp_path / "tok.json"
+    workspace["tokenizer"].save(p)
+    with caplog.at_level(logging.WARNING, logger="memvul_tpu.data.tokenizer"):
+        WordPieceTokenizer(
+            vocab_path=tmp_path / "does_not_exist_vocab.txt", tokenizer_path=p
+        )
+    assert any(
+        "does NOT exist" in r.message and "parity" in r.message
+        for r in caplog.records
+    )
+    # an existing vocab.txt must NOT warn
+    caplog.clear()
+    vocab = tmp_path / "vocab.txt"
+    vocab.write_text(
+        "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "##s"])
+    )
+    with caplog.at_level(logging.WARNING, logger="memvul_tpu.data.tokenizer"):
+        WordPieceTokenizer(vocab_path=vocab, tokenizer_path=p)
+    assert not [r for r in caplog.records if r.levelno >= logging.WARNING]
+
+
 # -- corpus pipeline ---------------------------------------------------------
 
 

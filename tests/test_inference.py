@@ -91,6 +91,20 @@ def test_sharded_matches_unsharded(ws, memory_setup, tmp_path):
             )
 
 
+def test_writer_thread_error_propagates(ws, memory_setup, tmp_path):
+    """predict_file serializes on a writer thread; a failure there (e.g.
+    unwritable output path) must surface to the caller, not hang or pass
+    silently."""
+    model, params, reader = memory_setup
+    pred = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=16, max_length=64
+    )
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    bad_path = tmp_path / "no_such_dir" / "result.json"
+    with pytest.raises(OSError):
+        pred.predict_file(reader, ws["paths"]["test"], bad_path)
+
+
 def test_bucketed_scoring_matches_pad_to_max(ws, memory_setup, tmp_path):
     """Length-binned batching re-orders reports but must not change any
     per-report anchor probability (buckets cover max_length, so no extra

@@ -50,8 +50,8 @@ def test_grad_accum_shapes_microbatch_stacks(ws):
         BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size),
         ws["tokenizer"], _tiny_cfg(ws, grad_accum=3, batch_size=4),
     )
-    lines = ["some words here to mask"] * 40
-    ids, mask, labels = next(trainer._batches(lines))
+    trainer._encode_corpus(["some words here to mask"] * 40)
+    ids, mask, labels = next(trainer._batches())
     assert ids.shape == (3, 4, 32)  # [K, B, L]
     assert mask.shape == (3, 4, 32) and labels.shape == (3, 4, 32)
 
@@ -63,8 +63,10 @@ def test_grad_accum_is_actually_applied(ws, corpus_file):
     t1 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=1))
     t2 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=2))
     lines = ["alpha beta gamma delta"] * 64
-    s1 = next(t1._batches(lines))[0]
-    s2 = next(t2._batches(lines))[0]
+    t1._encode_corpus(lines)
+    t2._encode_corpus(lines)
+    s1 = next(t1._batches())[0]
+    s2 = next(t2._batches())[0]
     assert s1.shape[0] * s1.shape[1] == 4
     assert s2.shape[0] * s2.shape[1] == 8
     out = t2.train(corpus_file)
@@ -108,6 +110,39 @@ def test_mlm_refuses_to_clobber_non_checkpoint_dir(ws, tmp_path):
         cfg, ws["tokenizer"],
         _tiny_cfg(ws, output_dir=str(out), overwrite_output_dir=True),
     )
+
+
+# -- tokenize-once pipeline ----------------------------------------------------
+
+def test_mlm_tokenizes_corpus_only_once(ws, corpus_file, monkeypatch):
+    """The packed token cache means exactly one tokenizer.encode per line
+    for the WHOLE run — epochs after the first only shuffle + mask
+    (reference tokenizes once via datasets.map, run_mlm_wwm.py:322-333)."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    t = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, num_epochs=3))
+    n_lines = sum(
+        1 for l in open(corpus_file, encoding="utf-8") if l.strip()
+    )
+    calls = {"n": 0}
+    real_encode = t.tokenizer.encode
+
+    def counting(text, **kw):
+        calls["n"] += 1
+        return real_encode(text, **kw)
+
+    monkeypatch.setattr(t.tokenizer, "encode", counting)
+    t.train(corpus_file)
+    assert calls["n"] == n_lines
+
+
+def test_mlm_loop_drains_losses_in_windows(ws, corpus_file):
+    """sync_every=1 and a large window must yield the same history."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    t1 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, num_epochs=1, sync_every=1))
+    t2 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, num_epochs=1, sync_every=64))
+    r1 = t1.train(corpus_file)
+    r2 = t2.train(corpus_file)
+    np.testing.assert_allclose(r1["history"], r2["history"], rtol=1e-6)
 
 
 # -- vectorized whole-word masking --------------------------------------------
@@ -207,15 +242,20 @@ def test_grad_accum_tail_stack_not_diluted(ws):
     t1 = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, grad_accum=1))
     # identical initial params by construction (same seed)
     lines = ["alpha beta gamma delta"] * 4  # one microbatch worth of rows
-    ids1, mask1, labels1 = next(t1._batches(lines))
+    t1._encode_corpus(lines)
+    ids1, mask1, labels1 = next(t1._batches())
     # tail stack: the single real microbatch plus 2 empty ones
     pad = ws["tokenizer"].pad_id
     ids3 = np.concatenate([ids1, np.full_like(ids1, pad), np.full_like(ids1, pad)])
     mask3 = np.concatenate([mask1, np.zeros_like(mask1), np.zeros_like(mask1)])
     from memvul_tpu.pretrain.mlm import IGNORE as IG
     labels3 = np.concatenate([labels1, np.full_like(labels1, IG), np.full_like(labels1, IG)])
-    rng = jax.random.PRNGKey(0)
-    p3, _, loss3 = t3._train_step(t3.params, t3.opt_state, ids3, mask3, labels3, rng)
-    p1, _, loss1 = t1._train_step(t1.params, t1.opt_state, ids1, mask1, labels1, rng)
+    # fresh keys per call: the jitted step donates its rng argument
+    p3, _, _, loss3 = t3._train_step(
+        t3.params, t3.opt_state, jax.random.PRNGKey(0), ids3, mask3, labels3
+    )
+    p1, _, _, loss1 = t1._train_step(
+        t1.params, t1.opt_state, jax.random.PRNGKey(0), ids1, mask1, labels1
+    )
     # loss not diluted by the empty microbatches
     np.testing.assert_allclose(float(loss3), float(loss1), rtol=1e-5)

@@ -85,7 +85,8 @@ def test_dp_tp_train_step_matches_single_device(scan_layers):
     }
     key = jax.random.PRNGKey(7)
 
-    _, _, loss_single, _ = jax.jit(step)(params, opt_state, stack, key)
+    _, _, _, stats_single = jax.jit(step)(params, opt_state, key, stack)
+    loss_single = stats_single["loss"]
 
     mesh = create_mesh({"data": 2, "model": 4})
     bad = validate_divisibility(params, mesh)
@@ -93,9 +94,10 @@ def test_dp_tp_train_step_matches_single_device(scan_layers):
     params_tp = shard_params(params, mesh)
     opt_state_tp = tx.init(params_tp)  # moments inherit the param shardings
     stack_tp = shard_batch(stack, mesh, batch_axis=1)
-    params_tp, opt_state_tp, loss_tp, _ = jax.jit(step)(
-        params_tp, opt_state_tp, stack_tp, key
+    params_tp, opt_state_tp, _, stats_tp = jax.jit(step)(
+        params_tp, opt_state_tp, key, stack_tp
     )
+    loss_tp = stats_tp["loss"]
     np.testing.assert_allclose(float(loss_single), float(loss_tp), rtol=2e-5)
     # updated params stay finite and sharded-correct
     leaf = params_tp["params"]["bert"]["embeddings"]["word_embeddings"]["embedding"]

@@ -235,6 +235,81 @@ def test_resume_restores_metrics_history(ws, tmp_path):
     assert len(t2.metrics_history) == len(r1["history"])
 
 
+def test_epoch_loop_runs_ahead_without_per_step_sync(ws, tmp_path, monkeypatch):
+    """The hot loop must issue many consecutive steps with no blocking
+    device→host transfer (the reference host-syncs every step,
+    custom_trainer.py:398-435): all pulls route through _host_fetch, so
+    counting its calls proves the loop runs ahead of the device."""
+    from memvul_tpu.training import trainer as trainer_mod
+
+    calls = []
+    real = trainer_mod._host_fetch
+
+    def counting(tree):
+        calls.append(len(tree))
+        return real(tree)
+
+    monkeypatch.setattr(trainer_mod, "_host_fetch", counting)
+    t = make_trainer(
+        ws, tmp_path, num_epochs=1, steps_per_epoch=6, sync_every=100,
+        serialization_dir=None,
+    )
+    metrics = t.train_epoch()
+    assert metrics["num_steps"] == 6
+    # one drain at epoch end covering all 6 steps — zero per-step syncs
+    assert calls == [6]
+
+
+def test_sync_every_preserves_metrics(ws, tmp_path):
+    """Windowed draining is an execution detail: per-step sync and
+    64-step windows must produce identical epoch metrics."""
+    t1 = make_trainer(
+        ws, tmp_path, num_epochs=1, steps_per_epoch=4, sync_every=1,
+        serialization_dir=None,
+    )
+    t2 = make_trainer(
+        ws, tmp_path, num_epochs=1, steps_per_epoch=4, sync_every=64,
+        serialization_dir=None,
+    )
+    m1, m2 = t1.train_epoch(), t2.train_epoch()
+    assert m1["loss"] == pytest.approx(m2["loss"])
+    assert m1["accuracy"] == pytest.approx(m2["accuracy"])
+    assert m1["f1-score"] == pytest.approx(m2["f1-score"])
+
+
+def test_update_confusion_matches_update():
+    from memvul_tpu.training.metrics import RunningClassification
+
+    preds = np.array([0, 1, 1, 0, 1])
+    labels = np.array([0, 1, 0, 0, 1])
+    weights = np.array([1.0, 1.0, 0.0, 1.0, 1.0])
+    r1 = RunningClassification(2, ["same", "diff"])
+    r1.update(preds, labels, weights)
+    cm = np.zeros((2, 2), np.int64)
+    for p, l, w in zip(preds, labels, weights):
+        if w > 0:
+            cm[l, p] += 1
+    r2 = RunningClassification(2, ["same", "diff"])
+    r2.update_confusion(cm)
+    assert r1.compute() == r2.compute()
+
+
+def test_ema_folded_into_step_still_averages(ws, tmp_path):
+    """EMA rides inside the jitted step now — the averaged params must
+    still trail the live params after a few updates."""
+    t = make_trainer(
+        ws, tmp_path, num_epochs=1, steps_per_epoch=3, ema_decay=0.5,
+        serialization_dir=None,
+    )
+    before = jax.device_get(jax.tree_util.tree_leaves(t.params)[0]).copy()
+    t.train_epoch()
+    live = jax.device_get(jax.tree_util.tree_leaves(t.params)[0])
+    ema = jax.device_get(jax.tree_util.tree_leaves(t.ema_params)[0])
+    assert not np.allclose(live, before)  # params moved
+    assert not np.allclose(ema, live)  # ema lags the live params
+    assert not np.allclose(ema, before)  # but it did move
+
+
 def test_fold_tokens_does_not_mutate_inputs():
     from memvul_tpu.models.folding import fold_tokens
 
