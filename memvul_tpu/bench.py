@@ -232,6 +232,11 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
                 out, err = "", ""
             out, err, timed_out = out or "", err or "", True
 
+        # the child's logs (e.g. the vocab-parity warning) must reach the
+        # operator even when the run succeeds
+        if err:
+            sys.stderr.write(err if len(err) < 20000 else err[-20000:])
+
         line = _extract_result_line(out)
         if line is not None:
             return line, None
@@ -241,7 +246,11 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
         if timed_out:
             last_error = f"attempt timed out after {attempt_timeout:.0f}s"
         else:
-            tail = ((err or "") + (out or "")).strip().splitlines()
+            # the real error lives on stderr; stdout only as a fallback so
+            # progress noise can't mask the exception text
+            err_lines = [l for l in (err or "").splitlines() if l.strip()]
+            out_lines = [l for l in (out or "").splitlines() if l.strip()]
+            tail = err_lines or out_lines
             last_error = tail[-1][:300] if tail else f"rc={proc.returncode}"
             if not any(m in (err + out) for m in _RETRYABLE_MARKERS):
                 return None, last_error  # not transient: don't burn retries

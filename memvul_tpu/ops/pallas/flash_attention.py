@@ -31,7 +31,9 @@ def flash_attention_or_fallback(
     bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     global _warned_bias
-    if jax.default_backend() == "tpu":
+    from ...utils.platform import is_tpu_backend
+
+    if is_tpu_backend():
         from .flash_kernel import UnsupportedBiasError, flash_attention
 
         try:

@@ -248,7 +248,9 @@ def flash_attention(
             f"[B, 1, 1, Tk]); got shape {None if bias is None else bias.shape}"
         )
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ...utils.platform import is_tpu_backend
+
+        interpret = not is_tpu_backend()
     return _flash_attention_vjp(
         query, key, value, key_bias, block_q, block_k, interpret
     )

@@ -354,15 +354,24 @@ class MLMTrainer:
     def corpus_size(self) -> int:
         return len(self._offsets) - 1 if hasattr(self, "_offsets") else 0
 
-    def _batches(self) -> Iterator[Tuple[np.ndarray, ...]]:
+    def _batches(
+        self, rng: Optional[np.random.Generator] = None
+    ) -> Iterator[Tuple[np.ndarray, ...]]:
         """[K, B, L] microbatch stacks (K = grad_accum) from the packed
         token cache.  The trailing partial stack is padded with empty
         rows — pad-only rows yield no maskable positions, so they
-        contribute no loss."""
+        contribute no loss.
+
+        ``rng``: the generator for shuffle + masking.  The training loop
+        passes a per-epoch generator spawned on the main thread because
+        this iterator runs on a prefetch worker — an abandoned worker
+        from a truncated epoch may overlap the next epoch's, and numpy
+        Generators are not thread-safe to share."""
         c = self.c
+        rng = self._np_rng if rng is None else rng
         n = self.corpus_size
         rows = c.batch_size * max(1, c.grad_accum)
-        order = self._np_rng.permutation(n)
+        order = rng.permutation(n)
         for start in range(0, n, rows):
             picked = order[start : start + rows]
             ids = np.full((rows, c.max_length), self.tokenizer.pad_id, np.int32)
@@ -372,7 +381,7 @@ class MLMTrainer:
                 ids[i, : len(seq)] = seq
                 mask[i, : len(seq)] = 1
             masked, labels = whole_word_mask(
-                ids, mask, self._np_rng, self.tokenizer.mask_id,
+                ids, mask, rng, self.tokenizer.mask_id,
                 self.tokenizer.vocab_size, self._continuation, self._special,
                 c.mask_prob,
             )
@@ -405,7 +414,12 @@ class MLMTrainer:
                     pending, jax.device_get, self.step, losses, what="MLM loss"
                 )
 
-            batches = prefetch(self._batches(), depth=max(1, c.prefetch_depth))
+            # per-epoch generator spawned on the main thread: the prefetch
+            # worker owns it exclusively (no cross-epoch thread sharing)
+            epoch_rng = np.random.default_rng(self._np_rng.integers(2**63))
+            batches = prefetch(
+                self._batches(epoch_rng), depth=max(1, c.prefetch_depth)
+            )
             for i, (ids, mask, labels) in enumerate(batches):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break

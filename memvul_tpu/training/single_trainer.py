@@ -191,10 +191,12 @@ class ClassifierTrainer:
                 )
                 pending.append(stats)
                 self.step += 1
-                if len(pending) >= max(1, c.sync_every):
+            if len(pending) >= max(1, c.sync_every):
+                with timer.distribute_over_last(len(pending)):
                     drain()
-        with timer.attribute_to_last():  # tail window's device work
-            drain()
+        if pending:
+            with timer.distribute_over_last(len(pending)):
+                drain()
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started

@@ -315,10 +315,12 @@ class MemoryTrainer:
                         )
                     pending.append(stats)
                     self.step += 1
-                    if len(pending) >= max(1, c.sync_every):
+                if len(pending) >= max(1, c.sync_every):
+                    with timer.distribute_over_last(len(pending)):
                         self._drain_stats(pending, running, losses)
-            with timer.attribute_to_last():  # tail window's device work
-                self._drain_stats(pending, running, losses)
+            if pending:
+                with timer.distribute_over_last(len(pending)):
+                    self._drain_stats(pending, running, losses)
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started

@@ -1,0 +1,29 @@
+"""Backend identification.
+
+The Pallas kernels must know whether real TPU hardware is underneath —
+but PJRT plugins can register under a platform name other than "tpu"
+(e.g. a tunnelled TPU appears as platform "axon" while its devices still
+report a TPU ``device_kind``).  Checking ``jax.default_backend() ==
+"tpu"`` alone would silently route the flash kernel to its XLA fallback
+on such rigs, which is exactly the hardware the kernel exists for.
+"""
+
+from __future__ import annotations
+
+
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend drives TPU hardware, regardless
+    of the platform name it registered under."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        devices = jax.devices()
+    except Exception:
+        return False
+    return any(
+        "tpu" in (getattr(d, "device_kind", "") or "").lower()
+        or getattr(d, "platform", "") == "tpu"
+        for d in devices
+    )

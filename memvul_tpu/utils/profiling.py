@@ -47,19 +47,30 @@ class StepTimer:
             self._durations.append(time.perf_counter() - start)
 
     @contextlib.contextmanager
-    def attribute_to_last(self) -> Iterator[None]:
-        """Fold the block's elapsed time into the LAST recorded step
-        instead of counting a new one — used for the tail stats-drain,
-        whose wait is device work belonging to the steps already issued."""
+    def distribute_over_last(self, n: int) -> Iterator[None]:
+        """Spread the block's elapsed time evenly over the last ``n``
+        recorded steps instead of counting a new one.
+
+        Used for windowed stats drains: with an async step loop the
+        per-step contexts measure dispatch only (microseconds) while the
+        drain absorbs the whole window's device time — raw percentiles
+        would be bimodal nonsense.  Distributing the drain restores
+        per-step timings that sum to wall clock and average to the true
+        step cost (the first step still carries its own compile time,
+        which happens synchronously at dispatch)."""
         start = time.perf_counter()
         try:
             yield
         finally:
             elapsed = time.perf_counter() - start
-            if self._durations:
-                self._durations[-1] += elapsed
-            elif elapsed > 0:
-                self._durations.append(elapsed)
+            if not self._durations:
+                if elapsed > 0:
+                    self._durations.append(elapsed)
+            else:
+                n = max(1, min(n, len(self._durations)))
+                share = elapsed / n
+                for i in range(len(self._durations) - n, len(self._durations)):
+                    self._durations[i] += share
 
     def __len__(self) -> int:
         return len(self._durations)

@@ -48,3 +48,75 @@ def test_reference_style_config_loads():
 def test_nested_override_dict_keys_are_literal():
     out = merge_overrides({"env": {}}, {"env": {"a.b": 1}})
     assert out == {"env": {"a.b": 1}}
+
+
+def test_shipped_longctx_config_selects_flash_attention():
+    """config_memory_longctx.json must name the Pallas kernel and build a
+    model whose encoder config carries it (round-2 verdict: a capability
+    no config can name is half-shipped)."""
+    from memvul_tpu.build import build_model
+
+    cfg = load_config("configs/config_memory_longctx.json")
+    model_cfg = cfg["model"]
+    assert model_cfg["encoder"]["attention_impl"] == "flash"
+    model = build_model(dict(model_cfg), vocab_size=512)
+    assert model.config.attention_impl == "flash"
+    assert model.config.max_position_embeddings == 4096
+    # eval section reads whole reports instead of folding at 512
+    assert cfg["evaluation"]["max_length"] == 4096
+
+
+def test_is_tpu_backend_false_on_cpu():
+    from memvul_tpu.utils.platform import is_tpu_backend
+
+    assert is_tpu_backend() is False
+
+
+def test_tpu_proofs_smoke_md_rendering(tmp_path):
+    """The proof harness's report generator renders both record kinds."""
+    import json as _json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, "tools")
+    import tpu_proofs
+
+    records = [
+        {
+            "kind": "flash_parity_timing",
+            "backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "rows": [
+                {
+                    "seq_len": 1024,
+                    "max_abs_err_valid_rows": 0.01,
+                    "flash_median_s": 0.002,
+                    "xla_median_s": 0.003,
+                    "speedup_vs_xla": 1.5,
+                }
+            ],
+        },
+        {
+            "kind": "train_smoke_base_geometry",
+            "backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "geometry": {"K": 2, "batch": 32, "seq_len": 256, "model": "bert-base",
+                         "scan_layers": True, "remat": True, "dtype": "bfloat16"},
+            "init_s": 1.0,
+            "first_step_s_incl_compile": 30.0,
+            "steady_step_median_s": 0.5,
+            "steady_step_min_s": 0.4,
+            "pairs_per_s": 128.0,
+            "first_loss": 0.9,
+            "last_loss": 0.7,
+            "peak_hbm_gb": 6.5,
+            "hbm_limit_gb": 16.0,
+        },
+    ]
+    src = tmp_path / "proofs.json"
+    src.write_text("\n".join(_json.dumps(r) for r in records))
+    out = tmp_path / "SMOKE.md"
+    tpu_proofs.write_smoke_md(src, out)
+    text = out.read_text()
+    assert "Flash kernel (Mosaic)" in text and "1024" in text
+    assert "Base-geometry train step" in text and "128.0 pairs/s" in text

@@ -1,0 +1,259 @@
+"""On-chip proof runs: flash-kernel parity/timing + base-geometry train smoke.
+
+Round-2 verdict items 2 and 3: the Pallas flash kernel had only ever run
+in interpret mode on CPU, and the production train-step geometry
+(reference: MemVul/config_memory.json:51,101 — batch 32 × grad-accum 2,
+length 256) had never executed outside tiny-CPU tests.  This tool runs
+both on the real chip and records the numbers:
+
+    python tools/tpu_proofs.py flash       # parity + timing at 1k/2k/4k
+    python tools/tpu_proofs.py trainsmoke  # bert-base train-step stack
+    python tools/tpu_proofs.py all
+
+Results are appended to ``TPU_PROOFS.json`` (one JSON object per run) and
+summarized in ``SMOKE.md``.  Run from the repo root on a TPU host.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+RESULTS = REPO / "TPU_PROOFS.json"
+SMOKE = REPO / "SMOKE.md"
+
+
+def _record(kind: str, payload: dict) -> None:
+    import jax
+
+    row = {
+        "kind": kind,
+        "backend": jax.default_backend(),
+        "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+        **payload,
+    }
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row))
+
+
+def _time_fn(fn, *args, iters: int = 10, warmup: int = 2) -> dict:
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return {
+        "median_s": statistics.median(times),
+        "min_s": min(times),
+        "iters": iters,
+    }
+
+
+def run_flash() -> dict:
+    """Mosaic-lowered flash kernel vs the XLA einsum formulation:
+    numerical parity and timing at 1k/2k/4k tokens with a ragged padding
+    mask (the capability superseding the reference's segment folding,
+    custom_PTM_embedder.py:244-381)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from memvul_tpu.ops.attention import _xla_attention
+    from memvul_tpu.ops.pallas.flash_kernel import flash_attention
+    from memvul_tpu.utils.platform import is_tpu_backend
+
+    assert is_tpu_backend(), "flash proof must run on TPU hardware"
+    B, H, D = 4, 12, 64
+    rows = []
+    rng = np.random.default_rng(0)
+    for T in (1024, 2048, 4096):
+        q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.bfloat16)
+        k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.bfloat16)
+        v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.bfloat16)
+        # ragged lengths: rows padded to 1/2, 3/4, full, full
+        lengths = [T // 2, 3 * T // 4, T, T]
+        mask = np.zeros((B, 1, 1, T), np.float32)
+        for i, L in enumerate(lengths):
+            mask[i, :, :, L:] = np.finfo(np.float32).min
+        bias = jnp.asarray(mask)
+
+        flash = jax.jit(
+            lambda q, k, v, b: flash_attention(q, k, v, b, interpret=False)
+        )
+        xla = jax.jit(
+            lambda q, k, v, b: _xla_attention(q, k, v, b, None, 0.0, True)
+        )
+        out_f = np.asarray(flash(q, k, v, bias), np.float32)
+        out_x = np.asarray(xla(q, k, v, bias), np.float32)
+        # padded query rows are unconstrained — compare valid rows only
+        max_err = 0.0
+        for i, L in enumerate(lengths):
+            max_err = max(
+                max_err, float(np.abs(out_f[i, :L] - out_x[i, :L]).max())
+            )
+        t_flash = _time_fn(flash, q, k, v, bias)
+        t_xla = _time_fn(xla, q, k, v, bias)
+        rows.append(
+            {
+                "seq_len": T,
+                "max_abs_err_valid_rows": max_err,
+                "flash_median_s": t_flash["median_s"],
+                "xla_median_s": t_xla["median_s"],
+                "speedup_vs_xla": t_xla["median_s"] / t_flash["median_s"],
+            }
+        )
+        assert max_err < 3e-2, f"flash parity broke at T={T}: {max_err}"
+    payload = {"shape": [B, "T", H, D], "dtype": "bfloat16", "rows": rows}
+    _record("flash_parity_timing", payload)
+    return payload
+
+
+def run_trainsmoke() -> dict:
+    """One real bert-base training step at the production geometry:
+    batch 32 × grad-accum 2, length 256, scan+remat, bf16 — compile time,
+    steady-state step time, peak HBM."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from memvul_tpu.models import BertConfig, MemoryModel
+    from memvul_tpu.training.optim import make_optimizer
+    from memvul_tpu.training.trainer import make_train_step
+    from memvul_tpu.utils.profiling import device_memory_stats
+
+    cfg = BertConfig.base(
+        vocab_size=30522, dtype=jnp.bfloat16, scan_layers=True, remat=True
+    )
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    t0 = time.perf_counter()
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    init_s = time.perf_counter() - t0
+    # the reference schedule's optimizer (config_memory.json:60-75)
+    tx, opt_state = make_optimizer(
+        params,
+        group_lrs={"embedder": 2e-5, "pooler": 5e-5},
+        base_lr=1e-4,
+        warmup_steps=10000,
+        grad_clip_norm=1.0,
+    )
+    step = jax.jit(make_train_step(model, tx), donate_argnums=(0, 1, 2))
+
+    K, B, L = 2, 32, 256
+    data_rng = np.random.default_rng(0)
+    stack = {
+        "sample1": {
+            "input_ids": data_rng.integers(0, 30000, (K, B, L)).astype(np.int32),
+            "attention_mask": np.ones((K, B, L), np.int32),
+        },
+        "sample2": {
+            "input_ids": data_rng.integers(0, 30000, (K, B, L)).astype(np.int32),
+            "attention_mask": np.ones((K, B, L), np.int32),
+        },
+        "label": data_rng.integers(0, 2, (K, B)).astype(np.int32),
+        "weight": np.ones((K, B), np.float32),
+    }
+    rng = jax.random.PRNGKey(0)
+
+    t0 = time.perf_counter()
+    params, opt_state, rng, stats = step(params, opt_state, rng, stack)
+    loss0 = float(stats["loss"])  # blocks: includes compile + first run
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        params, opt_state, rng, stats = step(params, opt_state, rng, stack)
+        loss = float(stats["loss"])  # per-step sync: measuring, not training
+        times.append(time.perf_counter() - t0)
+    mem = device_memory_stats()
+    payload = {
+        "geometry": {"K": K, "batch": B, "seq_len": L, "model": "bert-base",
+                     "scan_layers": True, "remat": True, "dtype": "bfloat16"},
+        "init_s": init_s,
+        "first_step_s_incl_compile": compile_s,
+        "steady_step_median_s": statistics.median(times),
+        "steady_step_min_s": min(times),
+        "pairs_per_s": (K * B) / statistics.median(times),
+        "first_loss": loss0,
+        "last_loss": loss,
+        "peak_hbm_gb": mem.get("peak_bytes_in_use", 0) / 1e9,
+        "hbm_limit_gb": mem.get("bytes_limit", 0) / 1e9,
+    }
+    assert np.isfinite(loss0) and np.isfinite(loss)
+    _record("train_smoke_base_geometry", payload)
+    return payload
+
+
+def write_smoke_md(results_path: Path = RESULTS, out_path: Path = SMOKE) -> None:
+    """Regenerate SMOKE.md from the accumulated proof records."""
+    if not results_path.exists():
+        return
+    rows = [json.loads(l) for l in results_path.read_text().splitlines() if l.strip()]
+    lines = [
+        "# TPU hardware proofs",
+        "",
+        "Recorded by `tools/tpu_proofs.py` on real TPU hardware (backend/"
+        "device noted per row). Regenerate: `python tools/tpu_proofs.py all`.",
+        "",
+    ]
+    for r in rows:
+        if r["kind"] == "flash_parity_timing":
+            lines += [
+                f"## Flash kernel (Mosaic) parity + timing — {r['device_kind']}",
+                "",
+                "| seq len | max abs err (valid rows) | flash median | XLA median | speedup |",
+                "|---|---|---|---|---|",
+            ]
+            for row in r["rows"]:
+                lines.append(
+                    f"| {row['seq_len']} | {row['max_abs_err_valid_rows']:.4f} "
+                    f"| {row['flash_median_s']*1e3:.2f} ms | {row['xla_median_s']*1e3:.2f} ms "
+                    f"| {row['speedup_vs_xla']:.2f}× |"
+                )
+            lines.append("")
+        elif r["kind"] == "train_smoke_base_geometry":
+            g = r["geometry"]
+            lines += [
+                f"## Base-geometry train step — {r['device_kind']}",
+                "",
+                f"bert-base, batch {g['batch']} × accum {g['K']}, len {g['seq_len']}, "
+                "scan+remat, bf16 (reference shape: config_memory.json:51,101):",
+                "",
+                f"- first step (incl. XLA compile): **{r['first_step_s_incl_compile']:.1f} s**",
+                f"- steady-state step: **{r['steady_step_median_s']*1e3:.0f} ms** "
+                f"({r['pairs_per_s']:.1f} pairs/s)",
+                f"- peak HBM: **{r['peak_hbm_gb']:.2f} GB** of {r['hbm_limit_gb']:.1f} GB",
+                f"- loss finite: {r['first_loss']:.4f} → {r['last_loss']:.4f}",
+                "",
+            ]
+    out_path.write_text("\n".join(lines))
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    what = args[0] if args else "all"
+    if what in ("flash", "all"):
+        run_flash()
+    if what in ("trainsmoke", "all"):
+        run_trainsmoke()
+    write_smoke_md()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
