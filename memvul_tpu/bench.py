@@ -24,9 +24,11 @@ cost IS the 512-token cost.  Our length-binned batcher is the structural
 win being measured.
 
 Env knobs: BENCH_SEQ_LEN (cap, default 512), BENCH_BUCKETS (comma list,
-default "64,128,256,512"; empty string = pad-everything-to-cap mode),
+default "64,128,256,512"; "auto" = padding-minimizing DP boundaries from
+a corpus length sample; empty string = pad-everything-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
-BENCH_REPORTS (default 16384).
+BENCH_REPORTS (default 16384), BENCH_ATTENTION (xla | flash, default xla),
+BENCH_MODEL (base | tiny — tiny is plumbing-validation only).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
@@ -83,11 +85,15 @@ def _run_bench() -> None:
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
     buckets_env = os.environ.get("BENCH_BUCKETS", "64,128,256,512")
-    buckets = (
-        tuple(int(b) for b in buckets_env.split(",") if b) if buckets_env else None
-    )
-    if buckets:
-        buckets = tuple(b for b in buckets if b <= seq_len) or (seq_len,)
+    auto_bucket_mode = buckets_env == "auto"
+    if auto_bucket_mode:
+        buckets = None  # derived from a corpus length sample below
+    else:
+        buckets = (
+            tuple(int(b) for b in buckets_env.split(",") if b) if buckets_env else None
+        )
+        if buckets:
+            buckets = tuple(b for b in buckets if b <= seq_len) or (seq_len,)
     # token budget per batch: 256k (batch 512 at seq 512, scaling up to
     # 4096 at seq 64) measured best on v5e — larger budgets waste rows on
     # partially-filled bucket tails, smaller ones under-fill the MXU;
@@ -113,12 +119,36 @@ def _run_bench() -> None:
         cfg = BertConfig.base(
             vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
         )
+    attn = os.environ.get("BENCH_ATTENTION", "xla")
+    if attn != "xla":
+        cfg = cfg.replace(attention_impl=attn)
     model = MemoryModel(cfg)
     dummy = {
         "input_ids": np.zeros((2, 8), np.int32),
         "attention_mask": np.ones((2, 8), np.int32),
     }
     params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    test_instances = list(reader.read(ws["paths"]["test"], split="test"))
+    while len(test_instances) < n_reports:
+        test_instances = test_instances + test_instances
+    test_instances = test_instances[:n_reports]
+
+    if auto_bucket_mode:
+        # boundaries at the corpus's natural knees (padding-minimizing DP
+        # over a token-length sample) instead of hand-picked powers of two
+        from memvul_tpu.data.batching import auto_buckets
+
+        sample = test_instances[:2048]
+        lengths = [
+            len(ws["tokenizer"].encode(i["text1"], max_length=seq_len))
+            for i in sample
+        ]
+        buckets = auto_buckets(lengths, seq_len, n_buckets=4)
+        print(f"auto buckets: {buckets}", file=sys.stderr)
 
     predictor = SiamesePredictor(
         model,
@@ -138,14 +168,6 @@ def _run_bench() -> None:
             {"text1": text, "meta": {"label": f"{cat}#{i}", "type": "golden"}}
         )
     predictor.encode_anchors(instances)
-
-    reader = MemoryReader(
-        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
-    )
-    test_instances = list(reader.read(ws["paths"]["test"], split="test"))
-    while len(test_instances) < n_reports:
-        test_instances = test_instances + test_instances
-    test_instances = test_instances[:n_reports]
 
     def run_pass():
         total = 0

@@ -51,7 +51,11 @@ def encoder_config(cfg: Optional[Dict[str, Any]], vocab_size: Optional[int] = No
         cfg["dtype"] = dtype
     if vocab_size is not None:
         cfg.setdefault("vocab_size", vocab_size)
-    factory = {"tiny": BertConfig.tiny, "base": BertConfig.base}[preset]
+    factory = {
+        "tiny": BertConfig.tiny,
+        "base": BertConfig.base,
+        "large": BertConfig.large,
+    }[preset]
     return factory(**cfg)
 
 

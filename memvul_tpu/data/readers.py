@@ -53,6 +53,22 @@ def detect_split(file_path: str) -> str:
     return TRAIN
 
 
+def _iter_corpus(file_path: str) -> Iterator[Dict]:
+    """Stream raw sample dicts from a corpus file.
+
+    ``.jsonl`` files (one record per line) stream without ever holding
+    the corpus in memory — the format for the full 1.2M-report scoring
+    job; plain ``.json`` arrays (the reference's artifact format,
+    utils.py:353-381) load at once."""
+    if str(file_path).endswith(".jsonl"):
+        with open(file_path, encoding="utf-8") as f:
+            for line in f:
+                if line.strip():
+                    yield json.loads(line)
+    else:
+        yield from json.loads(Path(file_path).read_text())
+
+
 class DatasetReader(Registrable):
     def read(self, file_path: str, split: Optional[str] = None) -> Iterator[Dict]:
         raise NotImplementedError
@@ -85,6 +101,22 @@ class MemoryReader(DatasetReader):
 
     # -- corpus handling -----------------------------------------------------
 
+    def _prepare_sample(self, s: Dict) -> Optional[Dict]:
+        """Normalize one raw corpus record in place: concatenated text,
+        pos/neg target, CWE resolution via the CVE record.  Returns None
+        for dirty positives lacking a CWE (reference drops those,
+        reader_memory.py:103-105)."""
+        s["text"] = f"{s.get('Issue_Title') or ''}. {s.get('Issue_Body') or ''}"
+        if str(s.get(self._target)) in ("1", "1.0"):
+            cwe_id = s.get("CWE_ID") or self._cve.get(s.get("CVE_ID"), {}).get("CWE_ID")
+            if cwe_id is None:
+                return None
+            s[self._target] = "pos"
+            s["CWE_ID"] = cwe_id
+        else:
+            s[self._target] = "neg"
+        return s
+
     def _cve_description(self, cve_id: str) -> str:
         """CVE descriptions need tag replacement exactly once
         (reference: reader_memory.py:96-99)."""
@@ -99,19 +131,14 @@ class MemoryReader(DatasetReader):
         positives under their CWE category (via the CVE record)."""
         if file_path in self._grouped_cache:
             return self._grouped_cache[file_path]
-        samples = json.loads(Path(file_path).read_text())
         grouped: Dict[str, List[Dict]] = {"neg": []}
-        for s in samples:
-            s["text"] = f"{s.get('Issue_Title') or ''}. {s.get('Issue_Body') or ''}"
-            if str(s.get(self._target)) in ("1", "1.0"):
-                cwe_id = s.get("CWE_ID") or self._cve.get(s.get("CVE_ID"), {}).get("CWE_ID")
-                if cwe_id is None:
-                    continue  # positives lacking a CWE are dirty data
-                s[self._target] = "pos"
-                s["CWE_ID"] = cwe_id
-                grouped.setdefault(cwe_id, []).append(s)
+        for s in _iter_corpus(file_path):
+            s = self._prepare_sample(s)
+            if s is None:
+                continue  # positives lacking a CWE are dirty data
+            if s[self._target] == "pos":
+                grouped.setdefault(s["CWE_ID"], []).append(s)
             else:
-                s[self._target] = "neg"
                 grouped["neg"].append(s)
         self._grouped_cache[file_path] = grouped
         return grouped
@@ -123,20 +150,34 @@ class MemoryReader(DatasetReader):
         if split == GOLDEN:
             yield from self.read_anchors(file_path)
             return
-        grouped = self.group_by_cwe(file_path)
         if split in (TEST, VALIDATION, UNLABEL):
             # reference semantics: test corpora stream as unlabeled scoring
             # instances, validation as labeled "test" instances
-            # (reference: reader_memory.py:146-162)
+            # (reference: reader_memory.py:146-162).  Evaluation is
+            # one-pass, so the corpus streams sample-by-sample — a .jsonl
+            # file never materializes in host RAM (the 1.2M-report job);
+            # a cached grouped corpus is reused when one exists.
             mode = "test" if split == VALIDATION else UNLABEL
             count = 0
-            for bucket in grouped.values():
-                for s in bucket:
-                    count += 1
-                    yield self._eval_instance(s, mode)
+            if file_path in self._grouped_cache:
+                samples = (
+                    s
+                    for bucket in self._grouped_cache[file_path].values()
+                    for s in bucket
+                )
+            else:
+                samples = (
+                    prepared
+                    for s in _iter_corpus(file_path)
+                    if (prepared := self._prepare_sample(s)) is not None
+                )
+            for s in samples:
+                count += 1
+                yield self._eval_instance(s, mode)
             logger.info("%s: %d evaluation instances", file_path, count)
         else:
-            yield from self._train_pairs(grouped)
+            # pair generation needs same-CWE partner lookup: grouped corpus
+            yield from self._train_pairs(self.group_by_cwe(file_path))
 
     def read_anchors(self, anchor_path: Optional[str] = None) -> Iterator[Dict]:
         anchors = (
@@ -229,8 +270,7 @@ class SingleReader(DatasetReader):
 
     def read(self, file_path: str, split: Optional[str] = None) -> Iterator[Dict]:
         split = split or detect_split(file_path)
-        samples = json.loads(Path(file_path).read_text())
-        for s in samples:
+        for s in _iter_corpus(file_path):
             positive = str(s.get(self._target)) in ("1", "1.0", "pos")
             if (
                 split == TRAIN

@@ -34,7 +34,7 @@ from ..data.batching import (
 )
 from ..data.readers import MemoryReader
 from ..models.memory import MemoryModel, anchor_probs
-from ..parallel.mesh import create_mesh, replicate, shard_batch
+from ..parallel.mesh import MODEL_AXIS, create_mesh, replicate, shard_batch
 from ..training.metrics import SiameseMeasure
 from .measure import cal_metrics
 
@@ -69,7 +69,8 @@ class SiamesePredictor:
         else:
             self.bucket_sizes = None
         self.params = replicate(params, mesh) if mesh is not None else params
-        self.anchor_bank = None  # [A, D] device array
+        self.anchor_bank = None  # [A(+pad), D] device array
+        self.n_anchors = 0  # real (unpadded) bank size
         self.anchor_labels: List[str] = []
 
         self._encode_fn = jax.jit(
@@ -108,10 +109,32 @@ class SiamesePredictor:
             embeddings = np.asarray(self._encode_fn(self.params, batch))
             chunks.append(embeddings[: len(chunk)])
         bank = np.concatenate(chunks, axis=0)
-        self.anchor_bank = (
-            replicate(bank, self.mesh) if self.mesh is not None else jax.device_put(bank)
+        self.n_anchors = bank.shape[0]
+        n_model = self.mesh.shape.get(MODEL_AXIS, 1) if self.mesh is not None else 1
+        if n_model > 1:
+            # CWE-1000 stretch: shard the anchor axis over "model" so the
+            # [B, A, D] |u−v| intermediate of the bank match (the only
+            # O(B·A·D) tensor, models/memory.py:match_anchors) splits
+            # across both mesh axes; zero-pad rows to divisibility — their
+            # scores are sliced off before anything downstream sees them
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            pad = (-self.n_anchors) % n_model
+            if pad:
+                bank = np.concatenate(
+                    [bank, np.zeros((pad, bank.shape[1]), bank.dtype)], axis=0
+                )
+            self.anchor_bank = jax.device_put(
+                bank, NamedSharding(self.mesh, P(MODEL_AXIS, None))
+            )
+        elif self.mesh is not None:
+            self.anchor_bank = replicate(bank, self.mesh)
+        else:
+            self.anchor_bank = jax.device_put(bank)
+        logger.info(
+            "anchor bank: %d anchors (%d padded), dim %d, model-sharding ×%d",
+            self.n_anchors, bank.shape[0] - self.n_anchors, bank.shape[1], n_model,
         )
-        logger.info("anchor bank: %d anchors, dim %d", *bank.shape)
 
     # -- phase 2: streaming scoring ------------------------------------------
 
@@ -159,7 +182,8 @@ class SiamesePredictor:
             prefetch(batches, depth=prefetch_depth), dispatch, inflight=inflight
         ):
             metas = batch["meta"]
-            yield np.asarray(dev)[: len(metas)], metas
+            # drop dead rows and any zero-padded anchor columns
+            yield np.asarray(dev)[: len(metas), : self.n_anchors], metas
 
     def predict_file(
         self,

@@ -63,6 +63,22 @@ class BertConfig:
         """bert-base-uncased geometry (the reference's encoder)."""
         return cls(vocab_size=vocab_size, **kw)
 
+    @classmethod
+    def large(cls, vocab_size: int = 30522, **kw) -> "BertConfig":
+        """bert-large geometry — the SURVEY §7 stretch encoder (the
+        reference never scales past base; this is where the ``model``
+        mesh axis starts paying: 16 heads / 4096 FFN split cleanly over
+        tp=2/4/8)."""
+        defaults = dict(
+            vocab_size=vocab_size,
+            hidden_size=1024,
+            num_layers=24,
+            num_heads=16,
+            intermediate_size=4096,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
     def replace(self, **kw) -> "BertConfig":
         return dataclasses.replace(self, **kw)
 

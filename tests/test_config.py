@@ -120,3 +120,29 @@ def test_tpu_proofs_smoke_md_rendering(tmp_path):
     text = out.read_text()
     assert "Flash kernel (Mosaic)" in text and "1024" in text
     assert "Base-geometry train step" in text and "128.0 pairs/s" in text
+
+
+def test_shipped_large_tp_config_builds_and_splits():
+    """config_memory_large_tp.json: the stretch encoder must build at
+    bert-large geometry and divide cleanly over a model=8 axis
+    (shape-level only — no forward at 334M params)."""
+    import jax
+    import numpy as np
+
+    from memvul_tpu.build import build_model
+    from memvul_tpu.parallel import create_mesh
+    from memvul_tpu.parallel.sharding import validate_divisibility
+
+    cfg = load_config("configs/config_memory_large_tp.json")
+    model = build_model(dict(cfg["model"]), vocab_size=30522)
+    c = model.config
+    assert (c.num_layers, c.hidden_size, c.num_heads, c.intermediate_size) == (
+        24, 1024, 16, 4096,
+    )
+    dummy = {
+        "input_ids": jax.ShapeDtypeStruct((2, 8), np.int32),
+        "attention_mask": jax.ShapeDtypeStruct((2, 8), np.int32),
+    }
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0), dummy, dummy)
+    mesh = create_mesh({"data": 1, "model": 8})
+    assert not validate_divisibility(params, mesh)

@@ -347,3 +347,100 @@ def test_explicit_unlabel_split_mode(workspace):
     assert all(i["meta"]["type"] == "unlabel" for i in insts)
     insts_v = list(reader.read(workspace["paths"]["validation"], split="validation"))
     assert all(i["meta"]["type"] == "test" for i in insts_v)
+
+
+def test_jsonl_corpus_streams_identically(workspace, tmp_path):
+    """A .jsonl corpus (the streaming format for the 1.2M-report job)
+    must yield exactly the same eval instances as the .json array."""
+    import json as _json
+
+    from memvul_tpu.data.readers import MemoryReader, SingleReader
+
+    src = workspace["paths"]["test"]
+    samples = _json.loads(open(src).read())
+    jsonl = tmp_path / "test_stream.jsonl"
+    jsonl.write_text("\n".join(_json.dumps(s) for s in samples))
+
+    reader = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+    )
+    from_json = list(reader.read(src, split="test"))
+    # fresh reader: no grouped cache for the jsonl path
+    reader2 = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+    )
+    from_jsonl = list(reader2.read(str(jsonl), split="test"))
+    assert from_json == from_jsonl
+    assert not reader2._grouped_cache  # streaming never built the dict
+
+    single = SingleReader()
+    assert list(single.read(src, split="test")) == list(
+        single.read(str(jsonl), split="test")
+    )
+
+
+# -- auto bucketing -----------------------------------------------------------
+
+
+def test_auto_buckets_beats_powers_of_two_on_skewed_sample():
+    from memvul_tpu.data.batching import auto_buckets
+
+    rng = np.random.default_rng(0)
+    # long-tailed mix: most reports short, a capped heavy tail
+    lengths = np.concatenate([
+        rng.integers(20, 60, 800),
+        rng.integers(90, 130, 150),
+        np.full(50, 512),
+    ])
+    buckets = auto_buckets(lengths, max_length=512, n_buckets=4)
+    assert buckets[-1] == 512
+    assert len(buckets) <= 4
+
+    def padded(bounds):
+        total = 0
+        for l in np.minimum(lengths, 512):
+            total += next(b for b in bounds if b >= l)
+        return total
+
+    assert padded(buckets) <= padded((64, 128, 256, 512))
+
+
+def test_auto_buckets_properties():
+    from memvul_tpu.data.batching import auto_buckets, validate_buckets
+
+    assert auto_buckets([], 512) == (512,)
+    # every sampled length fits some bucket; final bound is max_length
+    lengths = [5, 9, 17, 200, 600]
+    b = auto_buckets(lengths, max_length=256, n_buckets=3, align=8)
+    assert b[-1] == 256
+    assert all(any(x >= min(l, 256) for x in b) for l in lengths)
+    # output always satisfies the coverage contract
+    assert validate_buckets(b, 256) == b
+    # boundaries are ascending and unique
+    assert list(b) == sorted(set(b))
+
+
+def test_auto_buckets_exact_on_two_clusters():
+    """Two tight clusters + the free cap boundary: with a 3-bucket budget
+    the DP lands interior boundaries at the aligned cluster maxima."""
+    from memvul_tpu.data.batching import auto_buckets
+
+    lengths = [30, 31, 32, 120, 121, 122]
+    b = auto_buckets(lengths, max_length=512, n_buckets=3, align=8)
+    assert b == (32, 128, 512)
+
+
+def test_auto_buckets_respects_bucket_budget_including_cap():
+    """The forced max_length boundary must count against n_buckets when
+    the sample never reaches the cap — never n_buckets+1 programs."""
+    from memvul_tpu.data.batching import auto_buckets
+
+    lengths = [20] * 100 + [60] * 50 + [100] * 20 + [200] * 5
+    b = auto_buckets(lengths, max_length=512, n_buckets=4)
+    assert len(b) <= 4
+    assert b[-1] == 512
+    # sample reaching the cap: all four buckets available to the DP
+    b2 = auto_buckets(lengths + [512] * 10, max_length=512, n_buckets=4)
+    assert len(b2) <= 4 and b2[-1] == 512

@@ -124,3 +124,55 @@ def test_shard_params_without_model_axis_replicates():
     placed = shard_params(params, mesh)
     leaf = placed["params"]["pair_kernel"]
     assert leaf.sharding.is_fully_replicated
+
+
+def test_model_sharded_anchor_bank_matches_replicated(tmp_path):
+    """CWE-1000 stretch: sharding the anchor axis over "model" (with
+    zero-padding to divisibility) must reproduce the replicated-bank
+    scores exactly — pad-anchor columns never escape the predictor."""
+    from memvul_tpu.data.readers import MemoryReader
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+
+    ws = build_workspace(tmp_path / "ws", seed=9)
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    anchors = list(reader.read_anchors(ws["paths"]["anchors"]))
+    # force a bank size that does NOT divide the model axis so the
+    # zero-padding branch actually runs
+    if len(anchors) % 4 == 0:
+        anchors = anchors[:-1]
+    assert len(anchors) % 4 != 0 and len(anchors) >= 4
+
+    mesh = create_mesh({"data": 2, "model": 4})
+    pred_tp = SiamesePredictor(
+        model, params, ws["tokenizer"], mesh=mesh, batch_size=16, max_length=64
+    )
+    pred_plain = SiamesePredictor(
+        model, params, ws["tokenizer"], mesh=None, batch_size=16, max_length=64
+    )
+    results = {}
+    for name, pred in [("tp", pred_tp), ("plain", pred_plain)]:
+        pred.encode_anchors(anchors)
+        assert pred.n_anchors == len(anchors)
+        scores = {}
+        for probs, metas in pred.score_instances(
+            reader.read(ws["paths"]["test"], split="test")
+        ):
+            assert probs.shape[1] == len(anchors)  # pad columns sliced off
+            for row, meta in zip(probs, metas):
+                scores[meta["Issue_Url"]] = row
+        results[name] = scores
+    assert results["tp"].keys() == results["plain"].keys()
+    for url in results["plain"]:
+        np.testing.assert_allclose(
+            results["tp"][url], results["plain"][url], rtol=1e-4, atol=1e-5
+        )
