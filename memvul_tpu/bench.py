@@ -43,6 +43,7 @@ process is left holding the TPU.
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -115,6 +116,14 @@ def _run_bench() -> None:
     # meaningful at the default "base" geometry
     if os.environ.get("BENCH_MODEL", "base") == "tiny":
         cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+        if seq_len > cfg.max_position_embeddings:
+            seq_len = cfg.max_position_embeddings
+            if buckets:
+                buckets = tuple(b for b in buckets if b <= seq_len) or (seq_len,)
+            print(
+                f"tiny geometry: clamped BENCH_SEQ_LEN to {seq_len}",
+                file=sys.stderr,
+            )
     else:
         cfg = BertConfig.base(
             vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
@@ -184,6 +193,12 @@ def _run_bench() -> None:
     # reference pads essentially every batch to the cap — see module
     # docstring); scale only when the cap itself is overridden
     baseline = BASELINE_RPS_512 * (512.0 / seq_len)
+    # the denominator is an MFU *estimate* (20-40% band around the 30%
+    # point, module docstring); the headline must always carry that
+    # uncertainty, so emit the vs_baseline band over the MFU range
+    point = rps / baseline
+    lo = point * (0.30 / 0.40)  # baseline at 40% MFU (fastest plausible GPU)
+    hi = point * (0.30 / 0.20)  # baseline at 20% MFU (slowest plausible GPU)
     print(
         json.dumps(
             {
@@ -191,6 +206,7 @@ def _run_bench() -> None:
                 "value": round(rps, 1),
                 "unit": "reports/sec",
                 "vs_baseline": round(rps / baseline, 2),
+                "vs_baseline_band": [round(lo, 2), round(hi, 2)],
             }
         )
     )
@@ -273,7 +289,11 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
             err_lines = [l for l in (err or "").splitlines() if l.strip()]
             out_lines = [l for l in (out or "").splitlines() if l.strip()]
             tail = err_lines or out_lines
-            last_error = tail[-1][:300] if tail else f"rc={proc.returncode}"
+            # prefer the actual exception line over trailing boilerplate
+            # (JAX appends a traceback-filtering notice AFTER the error)
+            exc = [l for l in tail if re.match(r"^[\w.]+(Error|Exception)\b", l)]
+            pick = exc[-1] if exc else (tail[-1] if tail else None)
+            last_error = pick[:300] if pick else f"rc={proc.returncode}"
             if not any(m in (err + out) for m in _RETRYABLE_MARKERS):
                 return None, last_error  # not transient: don't burn retries
 

@@ -107,6 +107,28 @@ def test_zero_exit_without_result_fails_fast(tmp_path):
     assert counter.read_text() == "x"  # no retries burned
 
 
+def test_error_extraction_skips_jax_boilerplate(tmp_path):
+    """JAX prints a traceback-filtering notice AFTER the exception line;
+    the reported error must be the exception, not the notice."""
+    body = (
+        "import sys\n"
+        "sys.stderr.write('Traceback (most recent call last):\\n')\n"
+        "sys.stderr.write('jaxlib.xla_extension.XlaRuntimeError: "
+        "sequence length 512 exceeds cap\\n')\n"
+        "sys.stderr.write('--------------------\\n')\n"
+        "sys.stderr.write('For simplicity, JAX has removed its internal "
+        "frames from the traceback\\n')\n"
+        "sys.exit(1)\n"
+    )
+    line, err = _supervise(
+        _script_cmd(body), attempts=1, attempt_timeout=30, backoff=0
+    )
+    assert line is None
+    assert err.startswith(
+        "jaxlib.xla_extension.XlaRuntimeError: sequence length 512"
+    ), err
+
+
 def test_exhausted_retries_report_last_error(tmp_path):
     body = (
         "import sys\n"
