@@ -32,13 +32,19 @@ BENCH_MODEL (base | tiny — tiny is plumbing-validation only).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
-number).  ``main`` therefore runs the measurement in a child process with
-a hard per-attempt deadline and retries backend-initialisation failures
-with backoff (BENCH_ATTEMPTS, default 3; BENCH_ATTEMPT_TIMEOUT seconds,
-default 1500).  On unrecoverable failure it still prints exactly one JSON
-line — ``{"metric": ..., "value": 0.0, ..., "error": "..."}`` — never a
-bare traceback, and kills the child's whole process group so no stray
-process is left holding the TPU.
+number) or silently WEDGED — a dead client's lease held server-side makes
+the first device op hang, not error, for tens of minutes.  ``main``
+therefore first waits for the device with cheap short-timeout probe
+children (BENCH_DEVICE_WAIT total seconds, default 1800; BENCH_PROBE_TIMEOUT
+per probe, default 240 — generous vs observed ~20 s healthy init so a slow
+but healthy backend is never killed mid-op; 0 disables), then runs the
+measurement in a child
+process with a hard per-attempt deadline and retries backend-initialisation
+failures with backoff (BENCH_ATTEMPTS, default 3; BENCH_ATTEMPT_TIMEOUT
+seconds, default 1500).  On unrecoverable failure it still prints exactly
+one JSON line — ``{"metric": ..., "value": 0.0, ..., "error": "..."}`` —
+never a bare traceback, and kills the child's whole process group so no
+stray process is left holding the TPU.
 """
 
 import json
@@ -227,9 +233,21 @@ def _extract_result_line(text: str):
     return None
 
 
-def _kill_process_group(proc: "subprocess.Popen") -> None:
-    """SIGKILL the child's whole process group — nothing may be left
-    holding the TPU after a timed-out attempt."""
+def _kill_process_group(proc: "subprocess.Popen", grace: float = 0.0) -> None:
+    """Kill the child's whole process group — nothing may be left holding
+    the TPU after a timed-out attempt.  With ``grace`` > 0, SIGTERM first
+    and give the child that long to run its PJRT client destructors (a
+    cleanly-closed tunnel connection releases the device lease; an abrupt
+    kill can leave it held server-side)."""
+    if grace > 0:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+            proc.wait(timeout=grace)
+            return
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+        except subprocess.TimeoutExpired:
+            pass
     try:
         os.killpg(proc.pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError, OSError):
@@ -238,6 +256,63 @@ def _kill_process_group(proc: "subprocess.Popen") -> None:
         proc.wait(timeout=10)
     except Exception:
         pass
+
+
+_PROBE_BODY = (
+    "import os, jax\n"
+    "req = os.environ.get('JAX_PLATFORMS')\n"
+    "if req: jax.config.update('jax_platforms', req)\n"
+    "import jax.numpy as jnp\n"
+    "x = jnp.ones((8, 128))\n"
+    "print('DEVICE_OK', float((x @ x.T).sum()))\n"
+)
+
+
+def _wait_for_device(
+    total_budget: float, probe_timeout: float, interval: float, env=None
+) -> bool:
+    """Block until the backend answers a trivial device op, or give up.
+
+    The axon tunnel can wedge for tens of minutes (a dead client's lease is
+    held server-side); a wedged backend makes the bench child HANG at its
+    first device op rather than error.  Burning full attempt timeouts on
+    that is wasteful — instead spend cheap ~2-min probes until the device
+    responds, then run the real measurement.  Returns False once
+    ``total_budget`` seconds have elapsed without an answer.
+    """
+    deadline = time.monotonic() + total_budget
+    first = True
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return False
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_BODY],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            env=env,
+            start_new_session=True,
+        )
+        try:
+            # never overshoot the caller's total budget on a hung probe
+            out, _ = proc.communicate(timeout=min(probe_timeout, remaining))
+        except subprocess.TimeoutExpired:
+            # graceful first: a SIGTERM'd probe closes its tunnel
+            # connection cleanly instead of becoming one more dead client
+            # holding the device lease (the wedge this wait exists for)
+            _kill_process_group(proc, grace=10.0)
+            out = ""
+        if "DEVICE_OK" in out:
+            return True
+        if first:
+            sys.stderr.write(
+                "bench: backend not answering; probing until it recovers\n"
+            )
+            first = False
+        if time.monotonic() + interval >= deadline:
+            return False
+        time.sleep(interval)
 
 
 def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=None):
@@ -317,6 +392,26 @@ def main() -> int:
 
     cmd = [sys.executable, "-m", "memvul_tpu.bench"]
     child_env = dict(os.environ, **{_CHILD_ENV_FLAG: "1"})
+    device_wait = float(os.environ.get("BENCH_DEVICE_WAIT", "1800"))
+    if device_wait > 0 and not _wait_for_device(
+        device_wait,
+        probe_timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT", "240")),
+        interval=45.0,
+        env=child_env,
+    ):
+        print(
+            json.dumps(
+                {
+                    "metric": "siamese_scoring_throughput",
+                    "value": 0.0,
+                    "unit": "reports/sec",
+                    "vs_baseline": 0.0,
+                    "error": f"device did not answer within {device_wait:.0f}s "
+                    "(backend wedged/unavailable)",
+                }
+            )
+        )
+        return 1
     line, error = _supervise(cmd, attempts, attempt_timeout, backoff, env=child_env)
     if line is not None:
         print(line)

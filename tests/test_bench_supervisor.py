@@ -8,9 +8,10 @@ No JAX is involved here — the children are tiny shell-level scripts.
 """
 
 import json
+import os
 import sys
 
-from memvul_tpu.bench import _extract_result_line, _supervise
+from memvul_tpu.bench import _extract_result_line, _supervise, _wait_for_device
 
 RESULT = '{"metric": "siamese_scoring_throughput", "value": 1.0, "unit": "reports/sec", "vs_baseline": 1.0}'
 
@@ -140,3 +141,18 @@ def test_exhausted_retries_report_last_error(tmp_path):
     )
     assert line is None
     assert "UNAVAILABLE" in err
+
+
+def test_wait_for_device_succeeds_on_live_backend():
+    """This one probe child DOES import jax (CPU platform) — the only test
+    here that needs it; the budget allows ~2 probes so a JAX-less env
+    fails in bounded time rather than churning."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    assert _wait_for_device(90, probe_timeout=80, interval=0.1, env=env)
+
+
+def test_wait_for_device_gives_up_on_dead_backend():
+    """An unanswerable backend (bogus platform → probe errors, never prints
+    DEVICE_OK) must exhaust the budget and return False, not loop forever."""
+    env = dict(os.environ, JAX_PLATFORMS="no_such_platform")
+    assert not _wait_for_device(1, probe_timeout=60, interval=0.1, env=env)

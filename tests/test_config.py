@@ -97,6 +97,17 @@ def test_tpu_proofs_smoke_md_rendering(tmp_path):
             ],
         },
         {
+            "kind": "flash_grad_parity",
+            "backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "rows": [
+                {
+                    "seq_len": 1024,
+                    "rel_max_err": {"dq": 0.004, "dk": 0.003, "dv": 0.002},
+                }
+            ],
+        },
+        {
             "kind": "train_smoke_base_geometry",
             "backend": "tpu",
             "device_kind": "TPU v5 lite",
@@ -119,6 +130,7 @@ def test_tpu_proofs_smoke_md_rendering(tmp_path):
     tpu_proofs.write_smoke_md(src, out)
     text = out.read_text()
     assert "Flash kernel (Mosaic)" in text and "1024" in text
+    assert "gradient parity" in text and "0.0040" in text
     assert "Base-geometry train step" in text and "128.0 pairs/s" in text
 
 

@@ -7,6 +7,7 @@ length 256) had never executed outside tiny-CPU tests.  This tool runs
 both on the real chip and records the numbers:
 
     python tools/tpu_proofs.py flash       # parity + timing at 1k/2k/4k
+    python tools/tpu_proofs.py flashgrad   # custom-VJP gradient parity
     python tools/tpu_proofs.py trainsmoke  # bert-base train-step stack
     python tools/tpu_proofs.py all
 
@@ -90,17 +91,45 @@ def _time_on_device(fn, q, *rest, inner: int = 20, reps: int = 3) -> dict:
     }
 
 
+def _flash_fn(q, k, v, bias):
+    """Mosaic-lowered kernel (never interpret mode) — shared by the
+    forward and backward proofs so both test the same configuration."""
+    from memvul_tpu.ops.pallas.flash_kernel import flash_attention
+
+    return flash_attention(q, k, v, bias, interpret=False)
+
+
+def _xla_fn(q, k, v, bias):
+    from memvul_tpu.ops.attention import _xla_attention
+
+    return _xla_attention(q, k, v, bias, None, 0.0, True)
+
+
+def _attn_case(rng, b, t, h, d, lengths):
+    """bf16 q/k/v + -inf key-padding bias + valid-row mask for a ragged
+    batch — the shared input scaffolding for the flash proofs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.bfloat16)
+    mask = np.zeros((b, 1, 1, t), np.float32)
+    row_ok = np.zeros((b, t, 1, 1), np.float32)
+    for i, L in enumerate(lengths):
+        mask[i, :, :, L:] = np.finfo(np.float32).min
+        row_ok[i, :L] = 1.0
+    return q, k, v, jnp.asarray(mask), row_ok
+
+
 def run_flash() -> dict:
     """Mosaic-lowered flash kernel vs the XLA einsum formulation:
     numerical parity and timing at 1k/2k/4k tokens with a ragged padding
     mask (the capability superseding the reference's segment folding,
     custom_PTM_embedder.py:244-381)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from memvul_tpu.ops.attention import _xla_attention
-    from memvul_tpu.ops.pallas.flash_kernel import flash_attention
     from memvul_tpu.utils.platform import is_tpu_backend
 
     assert is_tpu_backend(), "flash proof must run on TPU hardware"
@@ -108,22 +137,12 @@ def run_flash() -> dict:
     rows = []
     rng = np.random.default_rng(0)
     for T in (1024, 2048, 4096):
-        q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.bfloat16)
-        k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.bfloat16)
-        v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.bfloat16)
         # ragged lengths: rows padded to 1/2, 3/4, full, full
         lengths = [T // 2, 3 * T // 4, T, T]
-        mask = np.zeros((B, 1, 1, T), np.float32)
-        for i, L in enumerate(lengths):
-            mask[i, :, :, L:] = np.finfo(np.float32).min
-        bias = jnp.asarray(mask)
+        q, k, v, bias, _ = _attn_case(rng, B, T, H, D, lengths)
 
-        flash = jax.jit(
-            lambda q, k, v, b: flash_attention(q, k, v, b, interpret=False)
-        )
-        xla = jax.jit(
-            lambda q, k, v, b: _xla_attention(q, k, v, b, None, 0.0, True)
-        )
+        flash = jax.jit(_flash_fn)
+        xla = jax.jit(_xla_fn)
         out_f = np.asarray(flash(q, k, v, bias), np.float32)
         out_x = np.asarray(xla(q, k, v, bias), np.float32)
         # padded query rows are unconstrained — compare valid rows only
@@ -150,6 +169,52 @@ def run_flash() -> dict:
         assert max_err < 3e-2, f"flash parity broke at T={T}: {max_err}"
     payload = {"shape": [B, "T", H, D], "dtype": "bfloat16", "rows": rows}
     _record("flash_parity_timing", payload)
+    return payload
+
+
+def run_flashgrad() -> dict:
+    """Backward parity on real Mosaic: the flash kernel's custom VJP vs
+    gradients of the XLA formulation.  The loss projects only valid query
+    rows (padded rows are unconstrained in both impls; padded KEY positions
+    carry -inf bias so their k/v gradients are zero in both)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from memvul_tpu.utils.platform import is_tpu_backend
+
+    assert is_tpu_backend(), "flash grad proof must run on TPU hardware"
+    B, H, D = 2, 12, 64
+    rows = []
+    rng = np.random.default_rng(1)
+    for T in (1024, 2048):
+        lengths = [T // 2, T]
+        q, k, v, bias, row_ok = _attn_case(rng, B, T, H, D, lengths)
+        proj = jnp.asarray(
+            rng.normal(size=(B, T, H, D)) * row_ok, jnp.float32
+        )  # fixed cotangent restricted to valid rows
+
+        def loss(attn_fn, q_, k_, v_):
+            out = attn_fn(q_, k_, v_, bias).astype(jnp.float32)
+            return (out * proj).sum()
+
+        g_f = jax.jit(jax.grad(lambda *a: loss(_flash_fn, *a), argnums=(0, 1, 2)))(
+            q, k, v
+        )
+        g_x = jax.jit(jax.grad(lambda *a: loss(_xla_fn, *a), argnums=(0, 1, 2)))(
+            q, k, v
+        )
+        errs = {}
+        for name, gf, gx in zip(("dq", "dk", "dv"), g_f, g_x):
+            gf = np.asarray(gf, np.float32)
+            gx = np.asarray(gx, np.float32)
+            scale = float(np.abs(gx).max()) or 1.0
+            errs[name] = float(np.abs(gf - gx).max()) / scale
+        rows.append({"seq_len": T, "rel_max_err": errs})
+        for name, e in errs.items():
+            assert e < 5e-2, f"flash {name} grad parity broke at T={T}: {e}"
+    payload = {"shape": [B, "T", H, D], "dtype": "bfloat16", "rows": rows}
+    _record("flash_grad_parity", payload)
     return payload
 
 
@@ -264,6 +329,23 @@ def write_smoke_md(results_path: Path = RESULTS, out_path: Path = SMOKE) -> None
                     f"| {f'{speedup:.2f}×' if speedup else 'n/a'} |"
                 )
             lines.append("")
+        elif r["kind"] == "flash_grad_parity":
+            lines += [
+                f"## Flash kernel (Mosaic) gradient parity — {r['device_kind']}",
+                "",
+                "Custom VJP vs XLA-formulation grads, valid-rows loss, bf16"
+                " (relative max err, normalized by the XLA grad's max):",
+                "",
+                "| seq len | dq | dk | dv |",
+                "|---|---|---|---|",
+            ]
+            for row in r["rows"]:
+                e = row["rel_max_err"]
+                lines.append(
+                    f"| {row['seq_len']} | {e['dq']:.4f} | {e['dk']:.4f} "
+                    f"| {e['dv']:.4f} |"
+                )
+            lines.append("")
         elif r["kind"] == "train_smoke_base_geometry":
             g = r["geometry"]
             lines += [
@@ -292,6 +374,8 @@ def main(argv=None) -> int:
     what = args[0] if args else "all"
     if what in ("flash", "all"):
         run_flash()
+    if what in ("flashgrad", "all"):
+        run_flashgrad()
     if what in ("trainsmoke", "all"):
         run_trainsmoke()
     write_smoke_md()
