@@ -108,6 +108,20 @@ def test_tpu_proofs_smoke_md_rendering(tmp_path):
             ],
         },
         {
+            "kind": "mlm_smoke_reference_geometry",
+            "backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "geometry": {"K": 2, "batch": 16, "seq_len": 256,
+                         "model": "bert-base", "vocab_size": 30522,
+                         "dtype": "bfloat16"},
+            "init_s": 1.0,
+            "first_step_s_incl_compile": 40.0,
+            "steady_step_median_s": 0.25,
+            "sequences_per_s": 128.0,
+            "first_loss": 10.3,
+            "last_loss": 10.1,
+        },
+        {
             "kind": "train_smoke_base_geometry",
             "backend": "tpu",
             "device_kind": "TPU v5 lite",
@@ -131,6 +145,7 @@ def test_tpu_proofs_smoke_md_rendering(tmp_path):
     text = out.read_text()
     assert "Flash kernel (Mosaic)" in text and "1024" in text
     assert "gradient parity" in text and "0.0040" in text
+    assert "MLM further-pretraining step" in text and "128.0 sequences/s" in text
     assert "Base-geometry train step" in text and "128.0 pairs/s" in text
 
 

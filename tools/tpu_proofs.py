@@ -9,6 +9,7 @@ both on the real chip and records the numbers:
     python tools/tpu_proofs.py flash       # parity + timing at 1k/2k/4k
     python tools/tpu_proofs.py flashgrad   # custom-VJP gradient parity
     python tools/tpu_proofs.py trainsmoke  # bert-base train-step stack
+    python tools/tpu_proofs.py mlmsmoke    # MLM step, reference geometry
     python tools/tpu_proofs.py all
 
 Results are appended to ``TPU_PROOFS.json`` (one JSON object per run) and
@@ -218,6 +219,38 @@ def run_flashgrad() -> dict:
     return payload
 
 
+def _time_step_loop(advance, state, n_steps: int):
+    """Time a train-step sequence with the tunnel RTT paid ONCE.
+
+    ``advance(state) -> (state, loss_array)`` dispatches one step.  The
+    first call is timed alone with a blocking loss fetch (compile + first
+    run); the next ``n_steps`` are dispatched back-to-back — they
+    serialize on-device through donated params — with a single final
+    scalar fetch, so the ~70 ms blocking-sync RTT does not inflate every
+    step the way a per-step ``float(loss)`` would (~15% at a ~500 ms
+    step).  Shared by the train and MLM smokes so both measure the same
+    way.  Returns (state, metrics dict)."""
+    import numpy as np
+
+    t0 = time.perf_counter()
+    state, loss = advance(state)
+    first_loss = float(loss)  # blocks: includes compile + first run
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = advance(state)
+    last_loss = float(loss)  # ONE sync for the whole chain
+    steady_s = (time.perf_counter() - t0) / n_steps
+    assert np.isfinite(first_loss) and np.isfinite(last_loss)
+    return state, {
+        "first_step_s_incl_compile": compile_s,
+        "steady_step_mean_s": steady_s,
+        "steps_timed": n_steps,
+        "first_loss": first_loss,
+        "last_loss": last_loss,
+    }
+
+
 def run_trainsmoke() -> dict:
     """One real bert-base training step at the production geometry:
     batch 32 × grad-accum 2, length 256, scan+remat, bf16 — compile time,
@@ -266,36 +299,106 @@ def run_trainsmoke() -> dict:
         "label": data_rng.integers(0, 2, (K, B)).astype(np.int32),
         "weight": np.ones((K, B), np.float32),
     }
-    rng = jax.random.PRNGKey(0)
-
-    t0 = time.perf_counter()
-    params, opt_state, rng, stats = step(params, opt_state, rng, stack)
-    loss0 = float(stats["loss"])  # blocks: includes compile + first run
-    compile_s = time.perf_counter() - t0
-
-    times = []
-    for _ in range(8):
-        t0 = time.perf_counter()
+    def advance(state):
+        params, opt_state, rng = state
         params, opt_state, rng, stats = step(params, opt_state, rng, stack)
-        loss = float(stats["loss"])  # per-step sync: measuring, not training
-        times.append(time.perf_counter() - t0)
+        return (params, opt_state, rng), stats["loss"]
+
+    _, m = _time_step_loop(advance, (params, opt_state, jax.random.PRNGKey(0)), 8)
     mem = device_memory_stats()
     payload = {
         "geometry": {"K": K, "batch": B, "seq_len": L, "model": "bert-base",
                      "scan_layers": True, "remat": True, "dtype": "bfloat16"},
         "init_s": init_s,
-        "first_step_s_incl_compile": compile_s,
-        "steady_step_median_s": statistics.median(times),
-        "steady_step_min_s": min(times),
-        "pairs_per_s": (K * B) / statistics.median(times),
-        "first_loss": loss0,
-        "last_loss": loss,
+        **m,
+        "pairs_per_s": (K * B) / m["steady_step_mean_s"],
         "peak_hbm_gb": mem.get("peak_bytes_in_use", 0) / 1e9,
         "hbm_limit_gb": mem.get("bytes_limit", 0) / 1e9,
     }
-    assert np.isfinite(loss0) and np.isfinite(loss)
     _record("train_smoke_base_geometry", payload)
     return payload
+
+
+def run_mlmsmoke() -> dict:
+    """One real MLM further-pretraining step at the reference schedule's
+    geometry (further_pretrain.json / run_mlm_wwm.py:145-147: batch 16 ×
+    grad-accum 2, length 256, bert-base) — compile time and steady-state
+    step time on chip.  Labels are synthesized directly (15% positions
+    supervised, rest IGNORE) so the proof times the jitted step, not the
+    host-side masking that tests already cover."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.models import BertConfig
+    from memvul_tpu.pretrain.mlm import IGNORE, MLMTrainer, MLMTrainerConfig
+    from memvul_tpu.utils.platform import is_tpu_backend
+
+    assert is_tpu_backend(), "mlm smoke must run on TPU hardware"
+    ws = build_workspace(
+        tempfile.mkdtemp(), seed=0, num_projects=2, reports_per_project=8
+    )
+    tok = ws["tokenizer"]
+    cfg = BertConfig.base(
+        vocab_size=max(30522, tok.vocab_size),
+        dtype=jnp.bfloat16,
+        scan_layers=True,
+        remat=True,
+    )
+    t0 = time.perf_counter()
+    trainer = MLMTrainer(cfg, tok, MLMTrainerConfig())
+    init_s = time.perf_counter() - t0
+
+    K, B, L = trainer.c.grad_accum, trainer.c.batch_size, trainer.c.max_length
+    rng_np = np.random.default_rng(0)
+    ids = rng_np.integers(5, tok.vocab_size, (K, B, L)).astype(np.int32)
+    mask = np.ones((K, B, L), np.int32)
+    labels = np.full((K, B, L), IGNORE, np.int32)
+    pick = rng_np.random((K, B, L)) < 0.15
+    labels[pick] = ids[pick]
+
+    from memvul_tpu.utils.profiling import device_memory_stats
+
+    def advance(state):
+        params, opt_state, rng = state
+        params, opt_state, rng, loss = trainer._train_step(
+            params, opt_state, rng, ids, mask, labels
+        )
+        return (params, opt_state, rng), loss
+
+    _, m = _time_step_loop(
+        advance, (trainer.params, trainer.opt_state, jax.random.PRNGKey(0)), 6
+    )
+    mem = device_memory_stats()
+    payload = {
+        "geometry": {"K": K, "batch": B, "seq_len": L, "model": "bert-base",
+                     "vocab_size": cfg.vocab_size, "dtype": "bfloat16"},
+        "init_s": init_s,
+        **m,
+        "sequences_per_s": (K * B) / m["steady_step_mean_s"],
+        "peak_hbm_gb": mem.get("peak_bytes_in_use", 0) / 1e9,
+        "hbm_limit_gb": mem.get("bytes_limit", 0) / 1e9,
+    }
+    _record("mlm_smoke_reference_geometry", payload)
+    return payload
+
+
+def _steady(r: dict) -> float:
+    """Steady-state step seconds — new records carry the single-sync mean,
+    older committed ones the per-step-sync median."""
+    return r.get("steady_step_mean_s", r.get("steady_step_median_s"))
+
+
+def _hbm_line(r: dict) -> str:
+    return (
+        f"- peak HBM: **{r['peak_hbm_gb']:.2f} GB** of {r['hbm_limit_gb']:.1f} GB"
+        if r.get("peak_hbm_gb")
+        else "- peak HBM: not reported by this backend "
+        "(axon PJRT plugin exposes no memory_stats)"
+    )
 
 
 def write_smoke_md(results_path: Path = RESULTS, out_path: Path = SMOKE) -> None:
@@ -346,6 +449,22 @@ def write_smoke_md(results_path: Path = RESULTS, out_path: Path = SMOKE) -> None
                     f"| {e['dv']:.4f} |"
                 )
             lines.append("")
+        elif r["kind"] == "mlm_smoke_reference_geometry":
+            g = r["geometry"]
+            lines += [
+                f"## MLM further-pretraining step — {r['device_kind']}",
+                "",
+                f"bert-base MLM head, batch {g['batch']} × accum {g['K']}, "
+                f"len {g['seq_len']} (reference schedule: further_pretrain.json,"
+                " run_mlm_wwm.py:145-147):",
+                "",
+                f"- first step (incl. XLA compile): **{r['first_step_s_incl_compile']:.1f} s**",
+                f"- steady-state step: **{_steady(r)*1e3:.0f} ms** "
+                f"({r['sequences_per_s']:.1f} sequences/s)",
+                _hbm_line(r),
+                f"- loss finite: {r['first_loss']:.4f} → {r['last_loss']:.4f}",
+                "",
+            ]
         elif r["kind"] == "train_smoke_base_geometry":
             g = r["geometry"]
             lines += [
@@ -355,14 +474,9 @@ def write_smoke_md(results_path: Path = RESULTS, out_path: Path = SMOKE) -> None
                 "scan+remat, bf16 (reference shape: config_memory.json:51,101):",
                 "",
                 f"- first step (incl. XLA compile): **{r['first_step_s_incl_compile']:.1f} s**",
-                f"- steady-state step: **{r['steady_step_median_s']*1e3:.0f} ms** "
+                f"- steady-state step: **{_steady(r)*1e3:.0f} ms** "
                 f"({r['pairs_per_s']:.1f} pairs/s)",
-                (
-                    f"- peak HBM: **{r['peak_hbm_gb']:.2f} GB** of {r['hbm_limit_gb']:.1f} GB"
-                    if r["peak_hbm_gb"]
-                    else "- peak HBM: not reported by this backend "
-                    "(axon PJRT plugin exposes no memory_stats)"
-                ),
+                _hbm_line(r),
                 f"- loss finite: {r['first_loss']:.4f} → {r['last_loss']:.4f}",
                 "",
             ]
@@ -378,6 +492,8 @@ def main(argv=None) -> int:
         run_flashgrad()
     if what in ("trainsmoke", "all"):
         run_trainsmoke()
+    if what in ("mlmsmoke", "all"):
+        run_mlmsmoke()
     write_smoke_md()
     return 0
 
