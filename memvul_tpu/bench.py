@@ -29,7 +29,8 @@ a corpus length sample, BENCH_BUCKET_COUNT of them, default 6; empty
 string = pad-everything-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
 BENCH_REPORTS (default 16384), BENCH_ATTENTION (xla | flash, default xla),
-BENCH_MODEL (base | tiny — tiny is plumbing-validation only).
+BENCH_MODEL (base | tiny — tiny is plumbing-validation only),
+BENCH_INFLIGHT (async device dispatch depth, default 2).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
@@ -189,10 +190,14 @@ def _run_bench() -> None:
         )
     predictor.encode_anchors(instances)
 
+    inflight = int(os.environ.get("BENCH_INFLIGHT", "2"))
+
     def run_pass():
         total = 0
         start = time.perf_counter()
-        for probs, metas in predictor.score_instances(iter(test_instances)):
+        for probs, metas in predictor.score_instances(
+            iter(test_instances), inflight=inflight
+        ):
             total += len(metas)
         return total, time.perf_counter() - start
 
