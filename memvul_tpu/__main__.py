@@ -163,6 +163,58 @@ def cmd_bench(args) -> int:
     return int(bench_main() or 0)
 
 
+def cmd_selfcheck(args) -> int:
+    """One-command acceptance run: synthetic corpus → tiny Siamese train →
+    archive → evaluate → metric-contract check.  Exercises every layer
+    (offline pipeline, reader pair-sampling, train step, threshold-swept
+    validation, archive round-trip, reference-format metrics) in a few
+    minutes on CPU.  The reference has no equivalent — its only
+    end-to-end check is a full training run (custom_trainer.py)."""
+    import tempfile
+
+    from .build import evaluate_from_archive, train_from_config
+    from .data.synthetic import build_workspace, selfcheck_config
+
+    workdir = Path(args.dir) if args.dir else Path(
+        tempfile.mkdtemp(prefix="memvul_selfcheck_")
+    )
+    print(f"selfcheck workspace: {workdir}", file=sys.stderr)
+    # 8 projects: the project-level 25% splits need that many for every
+    # split (train/validation/test) to be non-empty — with 4, validation
+    # gets 0 projects and the threshold sweep would run on nothing
+    ws = build_workspace(
+        workdir / "data",
+        seed=args.seed,
+        num_projects=args.projects,
+        reports_per_project=args.reports,
+    )
+    splits = {
+        name: len(json.loads(Path(ws["paths"][name]).read_text()))
+        for name in ("train", "validation", "test")
+    }
+    config = selfcheck_config(ws)
+    result = train_from_config(config, workdir / "out")
+    archive = result.get("archive")
+    metrics = evaluate_from_archive(
+        str(workdir / "out"),
+        ws["paths"]["test"],
+        str(workdir / "eval"),
+        name="selfcheck",
+        use_mesh=False,
+    )
+    required = ("TP", "FN", "TN", "FP", "prec", "f1", "auc")
+    missing = [k for k in required if k not in metrics]
+    ok = bool(archive) and not missing and all(splits.values())
+    print(json.dumps({
+        "selfcheck": "ok" if ok else "fail",
+        "archive": archive,
+        "splits": splits,
+        "missing_metric_keys": missing,
+        "metrics": {k: metrics.get(k) for k in required},
+    }, default=float))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, stream=sys.stderr,
                         format="%(levelname)s %(name)s: %(message)s")
@@ -212,6 +264,17 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bench", help="run the throughput benchmark")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "selfcheck",
+        help="end-to-end acceptance run on a synthetic corpus (CPU-friendly)",
+    )
+    p.add_argument("--dir", default=None, help="workspace dir (default: mkdtemp)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--projects", type=int, default=8,
+                   help="synthetic projects (≥8 keeps every split non-empty)")
+    p.add_argument("--reports", type=int, default=24, help="reports per project")
+    p.set_defaults(fn=cmd_selfcheck)
 
     args = parser.parse_args(argv)
     _honor_platform_env()

@@ -147,6 +147,48 @@ def corpus_texts(reports: List[Dict]) -> List[str]:
     return [f"{r['Issue_Title']}. {r['Issue_Body']}" for r in reports]
 
 
+def selfcheck_config(ws, **trainer_overrides):
+    """A tiny reference-shaped train config over a :func:`build_workspace`
+    artifact set — the geometry the CLI ``selfcheck`` command (and the
+    test suite) trains in seconds on CPU while exercising every layer:
+    reader pair-sampling, Siamese train step, threshold-swept validation,
+    archiving."""
+    trainer = {
+        "num_epochs": 1,
+        "patience": 2,
+        "batch_size": 4,
+        "grad_accum": 2,
+        "max_length": 48,
+        "eval_batch_size": 8,
+        "eval_max_length": 48,
+        "warmup_steps": 2,
+        "steps_per_epoch": 3,
+    }
+    trainer.update(trainer_overrides)
+    return {
+        "random_seed": 2021,
+        "tokenizer": {"type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"]},
+        "dataset_reader": {
+            "type": "reader_memory",
+            "sample_neg": 1.0,
+            "same_diff_ratio": {"same": 2, "diff": 2},
+            "cve_path": ws["paths"]["cve"],
+            "anchor_path": ws["paths"]["anchors"],
+        },
+        "train_data_path": ws["paths"]["train"],
+        "validation_data_path": ws["paths"]["validation"],
+        "model": {
+            "type": "model_memory",
+            "encoder": {"preset": "tiny", "vocab_size": 4096},
+            "use_header": True,
+            "header_dim": 32,
+            "temperature": 0.1,
+        },
+        "trainer": trainer,
+        "evaluation": {"batch_size": 8, "max_length": 48},
+    }
+
+
 def build_workspace(tmp_dir, seed: int = 0, **corpus_kwargs):
     """Materialize a full artifact set under ``tmp_dir``: train/validation/
     test JSON splits, CVE dict, anchors, and a trained tokenizer.  Returns a

@@ -17,7 +17,7 @@ from memvul_tpu.__main__ import main
 from memvul_tpu.archive import load_archive, save_archive
 from memvul_tpu.build import build_model, encoder_config, init_params
 from memvul_tpu.config import loads_config
-from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.data.synthetic import build_workspace, selfcheck_config
 
 CONFIGS_DIR = Path(__file__).resolve().parent.parent / "configs"
 
@@ -28,40 +28,9 @@ def ws(tmp_path_factory):
 
 
 def tiny_memory_config(ws, **trainer_kw):
-    trainer = {
-        "num_epochs": 1,
-        "patience": 2,
-        "batch_size": 4,
-        "grad_accum": 2,
-        "max_length": 48,
-        "eval_batch_size": 8,
-        "eval_max_length": 48,
-        "warmup_steps": 2,
-        "steps_per_epoch": 3,
-    }
-    trainer.update(trainer_kw)
-    return {
-        "random_seed": 2021,
-        "tokenizer": {"type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"]},
-        "dataset_reader": {
-            "type": "reader_memory",
-            "sample_neg": 1.0,
-            "same_diff_ratio": {"same": 2, "diff": 2},
-            "cve_path": ws["paths"]["cve"],
-            "anchor_path": ws["paths"]["anchors"],
-        },
-        "train_data_path": ws["paths"]["train"],
-        "validation_data_path": ws["paths"]["validation"],
-        "model": {
-            "type": "model_memory",
-            "encoder": {"preset": "tiny", "vocab_size": 4096},
-            "use_header": True,
-            "header_dim": 32,
-            "temperature": 0.1,
-        },
-        "trainer": trainer,
-        "evaluation": {"batch_size": 8, "max_length": 48},
-    }
+    # the shared selfcheck geometry (memvul_tpu/data/synthetic.py) —
+    # the CLI `selfcheck` command trains exactly this
+    return selfcheck_config(ws, **trainer_kw)
 
 
 # -- config parsing / model construction --------------------------------------
@@ -149,6 +118,19 @@ def test_archive_roundtrip_with_bert_vocab_txt(tmp_path):
 
 
 # -- end-to-end CLI ------------------------------------------------------------
+
+def test_cli_selfcheck(tmp_path, capsys):
+    """The one-command acceptance run: builds its own corpus, trains,
+    archives, evaluates, and reports the metric contract."""
+    rc = main(["selfcheck", "--dir", str(tmp_path / "sc"), "--reports", "12"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    report = json.loads(out)
+    assert rc == 0
+    assert report["selfcheck"] == "ok"
+    assert report["missing_metric_keys"] == []
+    assert all(report["splits"].values()), report["splits"]
+    assert Path(report["archive"]).exists()
+
 
 def test_cli_train_then_evaluate_memory(ws, tmp_path):
     config = tiny_memory_config(ws)
