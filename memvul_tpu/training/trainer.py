@@ -29,7 +29,7 @@ import logging
 import tempfile
 import time
 from pathlib import Path
-from typing import Any, Dict, Iterator, List, Optional, Union
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -137,6 +137,12 @@ class TrainerConfig:
     max_length: int = 256
     eval_batch_size: int = 512
     eval_max_length: int = 512
+    # length-binned validation batching (same mechanism as the evaluation
+    # block's buckets/tokens_per_batch): short reports stop paying
+    # eval_max_length padding during the per-epoch validation sweep.
+    # None = pad-to-max (the reference's collation)
+    eval_buckets: Optional[Sequence[int]] = None
+    eval_tokens_per_batch: Optional[int] = None
     warmup_steps: int = 10000
     total_steps: Optional[int] = None  # enables linear decay after warmup
     base_lr: float = 1e-4
@@ -350,6 +356,8 @@ class MemoryTrainer:
                 mesh=self.mesh,
                 batch_size=c.eval_batch_size,
                 max_length=c.eval_max_length,
+                buckets=tuple(c.eval_buckets) if c.eval_buckets else None,
+                tokens_per_batch=c.eval_tokens_per_batch,
             )
         predictor = self._val_predictor
         # validate with the averaged weights when EMA is on — the
