@@ -15,7 +15,7 @@ import dataclasses
 import logging
 import time
 from pathlib import Path
-from typing import Any, Dict, Iterator, List, Optional, Union
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +80,10 @@ class ClassifierTrainerConfig:
     max_length: int = 256
     eval_batch_size: int = 512
     eval_max_length: int = 512
+    # length-binned validation (same mechanism as the memory trainer's
+    # eval_buckets); None = pad-to-max
+    eval_buckets: Optional[Sequence[int]] = None
+    eval_tokens_per_batch: Optional[int] = None
     warmup_steps: int = 0
     total_steps: Optional[int] = None
     base_lr: float = 2e-5
@@ -220,6 +224,8 @@ class ClassifierTrainer:
                 mesh=self.mesh,
                 batch_size=c.eval_batch_size,
                 max_length=c.eval_max_length,
+                buckets=tuple(c.eval_buckets) if c.eval_buckets else None,
+                tokens_per_batch=c.eval_tokens_per_batch,
             )
         predictor = self._val_predictor
         predictor.params = self.params

@@ -173,6 +173,8 @@ def test_cli_train_single_classifier(ws, tmp_path):
         "trainer": {
             "num_epochs": 1, "batch_size": 4, "max_length": 48,
             "eval_batch_size": 8, "eval_max_length": 48,
+            # exercise the length-binned validation wiring end-to-end
+            "eval_buckets": [16, 48], "eval_tokens_per_batch": 256,
             "steps_per_epoch": 3,
         },
         "evaluation": {"batch_size": 8, "max_length": 48},
