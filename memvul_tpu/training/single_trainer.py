@@ -97,6 +97,8 @@ class ClassifierTrainerConfig:
     # steps allowed in flight before the accumulated stats are pulled to
     # the host (NaN guard fires in the pulled block); 1 = sync per step
     sync_every: int = 32
+    # checkify float-checks localizing the first NaN/inf op (debug only)
+    debug_checks: bool = False
 
 
 class ClassifierTrainer:
@@ -148,9 +150,12 @@ class ClassifierTrainer:
             else None
         )
         self.metrics_history: List[Dict[str, Any]] = []
-        self._step_fn = jax.jit(
+        from .trainer import jit_step
+
+        self._step_fn = jit_step(
             make_classifier_step(self.model, self.tx),
-            donate_argnums=(0, 1, 2),
+            donate=(0, 1, 2),
+            debug_checks=c.debug_checks,
         )
 
     # -- data ----------------------------------------------------------------

@@ -127,6 +127,28 @@ def make_train_step(model: MemoryModel, tx, ema_decay: Optional[float] = None):
     return train_step
 
 
+def jit_step(raw_step, donate, debug_checks: bool):
+    """jit a train step, optionally wrapped in checkify float-checks.
+
+    Debug mode deliberately does NOT donate: when ``err.throw()`` raises,
+    the caller's pre-step params/opt-state must stay alive so they can be
+    checkpointed or inspected post-mortem (donation would have deleted
+    them).  Shared by MemoryTrainer and ClassifierTrainer so the checkify
+    mechanism has one implementation and one test."""
+    if not debug_checks:
+        return jax.jit(raw_step, donate_argnums=donate)
+    from jax.experimental import checkify
+
+    checked = jax.jit(checkify.checkify(raw_step, errors=checkify.float_checks))
+
+    def _checked_step(*args):
+        err, out = checked(*args)
+        err.throw()  # raises with the first NaN/inf producer's location
+        return out
+
+    return _checked_step
+
+
 @dataclasses.dataclass
 class TrainerConfig:
     num_epochs: int = 30
@@ -159,6 +181,11 @@ class TrainerConfig:
     online_resample: bool = True
     # when set, epoch 0 is wrapped in a jax.profiler trace written here
     profile_dir: Optional[str] = None
+    # checkify float-checks on the train step: the existing NaN guard in
+    # _drain_stats *detects* a non-finite loss after the fact; this mode
+    # *localizes* the first NaN/inf-producing op (file:line inside the
+    # model) at the step that created it.  Syncs every step — debug only
+    debug_checks: bool = False
     # exponential moving average of params; validation/checkpoint use the
     # averaged weights (the reference's moving_average support,
     # custom_trainer.py:437-439,514-516)
@@ -229,9 +256,10 @@ class MemoryTrainer:
         # EMA rides inside the one jitted step (no second dispatch); input
         # state buffers are donated so base-geometry params/opt-state don't
         # double-buffer in HBM
-        self._train_step = jax.jit(
+        self._train_step = jit_step(
             make_train_step(self.model, self.tx, ema_decay=c.ema_decay),
-            donate_argnums=(0, 1, 2, 3) if c.ema_decay is not None else (0, 1, 2),
+            donate=(0, 1, 2, 3) if c.ema_decay is not None else (0, 1, 2),
+            debug_checks=c.debug_checks,
         )
 
     # -- data ----------------------------------------------------------------

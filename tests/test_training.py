@@ -236,6 +236,44 @@ def test_trainer_sharded_step(ws, tmp_path):
     assert np.isfinite(result["history"][0]["training_loss"])
 
 
+def test_trainer_debug_checks_clean_run(ws, tmp_path):
+    """debug_checks mode trains normally on healthy data."""
+    trainer = make_trainer(
+        ws, tmp_path, debug_checks=True, num_epochs=1, steps_per_epoch=2,
+        serialization_dir=None,
+    )
+    result = trainer.train()
+    assert np.isfinite(result["history"][0]["training_loss"])
+
+
+def test_trainer_debug_checks_localizes_nan(ws, tmp_path):
+    """Poisoned params must raise at the offending step with checkify's
+    localization (the NaN guard in _drain_stats only detects, N steps
+    later; this names the op)."""
+    from jax.experimental import checkify
+
+    trainer = make_trainer(
+        ws, tmp_path, debug_checks=True, num_epochs=1, steps_per_epoch=1,
+        serialization_dir=None,
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(trainer.params)
+    leaves = [
+        jnp.full_like(l, jnp.nan) if jnp.issubdtype(l.dtype, jnp.floating) else l
+        for l in leaves
+    ]
+    trainer.params = jax.tree_util.tree_unflatten(treedef, leaves)
+    with pytest.raises(checkify.JaxRuntimeError, match="nan"):
+        trainer.train()
+    # debug mode must NOT donate: the pre-step state stays inspectable
+    # for post-mortem (a donated buffer would raise 'Array has been
+    # deleted' here)
+    post = [
+        l for l in jax.tree_util.tree_leaves(trainer.params)
+        if jnp.issubdtype(l.dtype, jnp.floating)
+    ]
+    assert post and bool(jnp.isnan(post[0]).all())
+
+
 def test_metric_tracker_minimize_stores_raw_value():
     t = MetricTracker("-loss")
     t.update({"loss": 0.42}, 0)
