@@ -196,6 +196,9 @@ class MLMTrainerConfig:
     # steps allowed in flight before losses are pulled to the host (the
     # NaN guard fires in the pulled block); 1 = sync per step
     sync_every: int = 32
+    # checkify float-checks localizing the first NaN/inf op (debug only;
+    # shared mechanism: training/trainer.py jit_step)
+    debug_checks: bool = False
     # host batches prepared ahead of the device (masking off critical path)
     prefetch_depth: int = 4
 
@@ -275,7 +278,11 @@ class MLMTrainer:
             )
             return params, opt_state, rng, loss_sum / real_k
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        from ..training.trainer import jit_step
+
+        self._train_step = jit_step(
+            train_step, donate=(0, 1, 2), debug_checks=self.c.debug_checks
+        )
 
     # -- checkpoint / resume --------------------------------------------------
 

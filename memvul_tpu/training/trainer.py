@@ -133,8 +133,8 @@ def jit_step(raw_step, donate, debug_checks: bool):
     Debug mode deliberately does NOT donate: when ``err.throw()`` raises,
     the caller's pre-step params/opt-state must stay alive so they can be
     checkpointed or inspected post-mortem (donation would have deleted
-    them).  Shared by MemoryTrainer and ClassifierTrainer so the checkify
-    mechanism has one implementation and one test."""
+    them).  Shared by MemoryTrainer, ClassifierTrainer, and MLMTrainer so
+    the checkify mechanism has one implementation and one test."""
     if not debug_checks:
         return jax.jit(raw_step, donate_argnums=donate)
     from jax.experimental import checkify
