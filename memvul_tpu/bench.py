@@ -155,20 +155,19 @@ def _run_bench() -> None:
     test_instances = test_instances[:n_reports]
 
     if auto_bucket_mode:
-        # boundaries at the corpus's natural knees (padding-minimizing DP
-        # over a token-length sample) instead of hand-picked powers of two
-        from memvul_tpu.data.batching import auto_buckets
+        # boundaries at the corpus's natural knees instead of hand-picked
+        # powers of two — same sampling recipe as the `"buckets": "auto"`
+        # evaluation-config path so bench and production eval measure one
+        # bucketing policy.  6 boundaries ≈ 10% fewer padded tokens than
+        # the hand 64/128/256/512 on the realistic length distribution;
+        # beyond 8 the win flattens while per-bucket compile cost grows
+        from memvul_tpu.build import _auto_buckets_for_corpus
 
-        sample = test_instances[:2048]
-        lengths = [
-            len(ws["tokenizer"].encode(i["text1"], max_length=seq_len))
-            for i in sample
-        ]
-        # 6 boundaries measured ~10% fewer padded tokens than the hand
-        # 64/128/256/512 on the realistic length distribution; beyond 8
-        # the padding win flattens while per-bucket compile cost grows
         n_buckets = int(os.environ.get("BENCH_BUCKET_COUNT", "6"))
-        buckets = auto_buckets(lengths, seq_len, n_buckets=n_buckets)
+        buckets = _auto_buckets_for_corpus(
+            reader, ws["tokenizer"], ws["paths"]["test"], seq_len,
+            n_buckets=n_buckets,
+        )
         print(f"auto buckets: {buckets}", file=sys.stderr)
 
     predictor = SiamesePredictor(

@@ -157,6 +157,22 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
     for key in ("TP", "FN", "TN", "FP", "prec", "f1", "auc"):
         assert key in metrics
 
+    # "auto" buckets (padding-minimizing DP from a corpus length sample)
+    # must reproduce the pad-to-max metrics exactly
+    auto_dir = tmp_path / "eval_auto"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(auto_dir), "--name", "memvul", "--no-mesh",
+        "--overrides", json.dumps({"evaluation": {
+            "batch_size": 8, "max_length": 48,
+            "buckets": "auto", "n_buckets": 3, "tokens_per_batch": 256,
+        }}),
+    ])
+    assert rc == 0
+    auto_metrics = json.loads((auto_dir / "memvul_metric_all.json").read_text())
+    for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
+        assert auto_metrics[key] == pytest.approx(metrics[key], abs=1e-6), key
+
 
 def test_cli_train_single_classifier(ws, tmp_path):
     config = {
