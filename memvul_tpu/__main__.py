@@ -19,20 +19,14 @@ from __future__ import annotations
 import argparse
 import json
 import logging
-import os
 import sys
 from pathlib import Path
 
 
 def _honor_platform_env() -> None:
-    """A sitecustomize hook may pin jax to the TPU plugin before env vars
-    are consulted; re-assert an explicit ``JAX_PLATFORMS`` request so CPU
-    runs (e.g. virtual 8-device meshes) work from the CLI."""
-    requested = os.environ.get("JAX_PLATFORMS")
-    if requested:
-        import jax
+    from .utils.platform import honor_platform_env
 
-        jax.config.update("jax_platforms", requested)
+    honor_platform_env()
 
 
 def _parse_mesh(spec):

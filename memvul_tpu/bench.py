@@ -78,13 +78,9 @@ def _run_bench() -> None:
     import numpy as np
     import jax
 
-    # a sitecustomize hook may pin jax to the TPU plugin (and hang in its
-    # tunnel) even when the environment asks for another platform —
-    # re-assert the env's choice before the first device op (same guard as
-    # __graft_entry__.dryrun_multichip and tests/conftest.py)
-    requested = os.environ.get("JAX_PLATFORMS")
-    if requested:
-        jax.config.update("jax_platforms", requested)
+    from memvul_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
     import jax.numpy as jnp
 
     from memvul_tpu.data.synthetic import build_workspace

@@ -10,6 +10,23 @@ on such rigs, which is exactly the hardware the kernel exists for.
 
 from __future__ import annotations
 
+import os
+
+
+def honor_platform_env() -> None:
+    """Re-assert the environment's ``JAX_PLATFORMS`` choice.
+
+    A sitecustomize hook may pin jax to the TPU plugin (and hang in its
+    tunnel) even when the environment asks for another platform; calling
+    this before the first device op makes CPU runs (virtual 8-device
+    meshes, tests, tiny benches) work regardless.  Shared by the CLI,
+    the bench child, and ``__graft_entry__``."""
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested:
+        import jax
+
+        jax.config.update("jax_platforms", requested)
+
 
 def is_tpu_backend() -> bool:
     """True when the default JAX backend drives TPU hardware, regardless
