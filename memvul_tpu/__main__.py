@@ -112,8 +112,8 @@ def cmd_build_data(args) -> int:
 
     from .data.corpus import preprocess, split_by_project, write_json, write_mlm_corpus
     from .data.cwe import (
-        build_anchors, build_cwe_tree, cwe_distribution,
-        load_research_view_csv, save_anchors,
+        build_anchors, build_cwe_tree, build_full_view_anchors,
+        cwe_distribution, load_research_view_csv, save_anchors,
     )
 
     out = Path(args.out)
@@ -130,9 +130,18 @@ def cmd_build_data(args) -> int:
     write_json(test, out / "test_project.json")
     n_lines = write_mlm_corpus(clean, out / "train_project_mlm.txt")
 
+    if args.full_view_anchors and not args.cwe_csv:
+        print("--full-view-anchors requires --cwe-csv", file=sys.stderr)
+        return 2
     n_anchors = 0
-    if args.cwe_csv and cve_dict:
-        tree = build_cwe_tree(load_research_view_csv(args.cwe_csv))
+    n_full = 0
+    tree = (
+        build_cwe_tree(load_research_view_csv(args.cwe_csv))
+        if args.cwe_csv
+        else None
+    )
+    dist = None
+    if tree is not None and cve_dict:
         positives = [
             r for r in train if str(r.get("Security_Issue_Full")) in ("1", "1.0")
         ]
@@ -144,9 +153,15 @@ def cmd_build_data(args) -> int:
         anchors = build_anchors(dist, tree, cve_dict, seed=args.seed)
         save_anchors(anchors, out / "CWE_anchor_golden_project.json")
         n_anchors = len(anchors)
+    if args.full_view_anchors:
+        # works with or without a CVE dict (pure-taxonomy bank)
+        full = build_full_view_anchors(tree, cve_dict, dist, seed=args.seed)
+        save_anchors(full, out / "CWE_anchor_full_view.json")
+        n_full = len(full)
     print(json.dumps({
         "train": len(train), "validation": len(validation), "test": len(test),
         "mlm_lines": n_lines, "anchors": n_anchors,
+        "full_view_anchors": n_full,
     }))
     return 0
 
@@ -254,6 +269,9 @@ def main(argv=None) -> int:
     p.add_argument("--cwe-csv", default=None, help="CWE Research View 1000.csv")
     p.add_argument("--out", required=True)
     p.add_argument("--seed", type=int, default=2021)
+    p.add_argument("--full-view-anchors", action="store_true",
+                   help="also build the CWE-1000-scale bank (one anchor per "
+                   "Research View node; pairs with model-axis bank sharding)")
     p.set_defaults(fn=cmd_build_data)
 
     p = sub.add_parser("bench", help="run the throughput benchmark")

@@ -144,6 +144,45 @@ def cwe_distribution(
     return dist
 
 
+def _category_description(
+    tree: Dict[str, Dict],
+    bare_id: str,
+    member_cves: List[str],
+    cve_dict: Dict[str, Dict],
+    rng: "random.Random",
+    level: int,
+    num_cve_per_anchor: int,
+) -> str:
+    """One anchor's text (reference recipe, utils.py:310-350): in-view
+    nodes get the abstraction-ranked BFS subtree description plus up to
+    ``num_cve_per_anchor`` sampled member-CVE descriptions; out-of-view
+    categories get CVE descriptions alone, 3× as many.  Shared by the
+    train-seen bank and the full-view bank so the two can never drift."""
+    description = ""
+    if bare_id not in tree:
+        k = min(3 * num_cve_per_anchor, len(member_cves))
+        for cve_id in rng.sample(member_cves, k=k):
+            description += _with_period(
+                normalize_text(cve_dict[cve_id]["CVE_Description"])
+            )
+        return description.strip()
+    subtree = bfs_subtree(tree, bare_id, level)
+    ranked = sorted(
+        subtree,
+        key=lambda x: ABSTRACTION_RANK.get(
+            tree[x].get("Weakness Abstraction", ""), 4
+        ),
+    )
+    for node_id in ranked:
+        description += describe_cwe(tree, node_id)
+    k = min(num_cve_per_anchor, len(member_cves))
+    for cve_id in rng.sample(member_cves, k=k):
+        description += _with_period(
+            normalize_text(cve_dict[cve_id]["CVE_Description"])
+        )
+    return description.strip()
+
+
 def build_anchors(
     distribution: Dict[str, Dict],
     tree: Dict[str, Dict],
@@ -161,30 +200,57 @@ def build_anchors(
             continue  # CVE record missing its CWE — dirty data
         member_cves = list(info["CVE_distribution"].keys())
         bare_id = category.split("-", 1)[1] if "-" in category else category
-        description = ""
-        if bare_id not in tree:
-            # outside the Research View: CVE descriptions only, 3x as many
-            k = min(3 * num_cve_per_anchor, len(member_cves))
-            for cve_id in rng.sample(member_cves, k=k):
-                description += _with_period(
-                    normalize_text(cve_dict[cve_id]["CVE_Description"])
-                )
-        else:
-            subtree = bfs_subtree(tree, bare_id, level)
-            ranked = sorted(
-                subtree,
-                key=lambda x: ABSTRACTION_RANK.get(
-                    tree[x].get("Weakness Abstraction", ""), 4
-                ),
-            )
-            for node_id in ranked:
-                description += describe_cwe(tree, node_id)
-            k = min(num_cve_per_anchor, len(member_cves))
-            for cve_id in rng.sample(member_cves, k=k):
-                description += _with_period(
-                    normalize_text(cve_dict[cve_id]["CVE_Description"])
-                )
-        anchors[category] = description.strip()
+        anchors[category] = _category_description(
+            tree, bare_id, member_cves, cve_dict, rng, level, num_cve_per_anchor
+        )
+    return anchors
+
+
+def build_full_view_anchors(
+    tree: Dict[str, Dict],
+    cve_dict: Dict[str, Dict],
+    distribution: Optional[Dict[str, Dict]] = None,
+    level: int = 1,
+    num_cve_per_anchor: int = 5,
+    seed: Optional[int] = None,
+) -> Dict[str, str]:
+    """CWE-1000-scale external memory: one anchor per node of the whole
+    Research View, not just the CWEs seen in training.
+
+    The reference's bank is capped at the 129 train-time categories
+    (utils.py:347); this is the stretch bank that covers every weakness
+    class the view describes (~900+ nodes) PLUS every train-seen
+    out-of-view category (NVD-CWE-noinfo etc. — covered via the same
+    3×-CVE-description fallback as :func:`build_anchors`), so it is a
+    strict superset of the train-seen bank's categories.  Nodes with no
+    training CVEs get the subtree description alone.  The resulting bank
+    is the size the model-axis anchor sharding in
+    evaluate/predict_memory.py exists for."""
+    rng = random.Random(seed)
+    distribution = distribution or {}
+    cves_by_category = {
+        cat: list(info["CVE_distribution"].keys())
+        for cat, info in distribution.items()
+        if cat != "null"
+    }
+    categories = {f"CWE-{bare_id}": bare_id for bare_id in tree}
+    for cat in cves_by_category:  # train-seen out-of-view categories
+        categories.setdefault(
+            cat, cat.split("-", 1)[1] if "-" in cat else cat
+        )
+    anchors: Dict[str, str] = {}
+    for category, bare_id in categories.items():
+        description = _category_description(
+            tree,
+            bare_id,
+            cves_by_category.get(category, []),
+            cve_dict,
+            rng,
+            level,
+            num_cve_per_anchor,
+        )
+        if description:
+            anchors[category] = description
     return anchors
 
 

@@ -178,6 +178,33 @@ def test_build_anchors_deterministic(workspace):
     assert all(k.startswith("CWE-") for k in a1)
 
 
+def test_build_full_view_anchors_covers_every_tree_node():
+    """The CWE-1000-scale bank: one anchor per Research View node, CVE
+    descriptions folded in where training data has them, deterministic."""
+    from memvul_tpu.data.cwe import build_full_view_anchors
+
+    reports, cve_dict = generate_corpus(seed=7)
+    positives = [r for r in reports if r["Security_Issue_Full"] == "1"]
+    for r in positives:
+        r["CWE_ID"] = cve_dict[r["CVE_ID"]]["CWE_ID"]
+    dist = cwe_distribution(positives, cve_dict)
+    tree = build_cwe_tree(research_view_records())
+
+    full = build_full_view_anchors(tree, cve_dict, dist, seed=5)
+    assert {f"CWE-{i}" for i in tree} <= set(full)
+    # the full bank is a strict superset of the train-seen bank's
+    # categories — including out-of-view ones (NVD-CWE-noinfo etc.)
+    train_bank = build_anchors(dist, tree, cve_dict, seed=5)
+    assert set(train_bank) <= set(full)
+    # determinism
+    assert full == build_full_view_anchors(tree, cve_dict, dist, seed=5)
+    # works with no distribution at all (pure-taxonomy bank over the view)
+    bare = build_full_view_anchors(tree, cve_dict)
+    assert set(bare) == {f"CWE-{i}" for i in tree}
+    for text in bare.values():
+        assert text  # every anchor has a real description
+
+
 def test_anchor_for_unknown_cwe_uses_cve_descriptions():
     cve_dict = {
         f"CVE-1-{i}": {"CWE_ID": "NVD-CWE-noinfo", "CVE_Description": f"desc {i}"}
