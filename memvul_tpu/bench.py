@@ -30,7 +30,8 @@ string = pad-everything-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
 BENCH_REPORTS (default 16384), BENCH_ATTENTION (xla | flash, default xla),
 BENCH_MODEL (base | tiny — tiny is plumbing-validation only),
-BENCH_INFLIGHT (async device dispatch depth, default 2).
+BENCH_INFLIGHT (async device dispatch depth, default 2),
+BENCH_PROFILE (dir — capture a jax.profiler trace of the timed pass).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
@@ -196,8 +197,12 @@ def _run_bench() -> None:
             total += len(metas)
         return total, time.perf_counter() - start
 
+    from memvul_tpu.utils.profiling import trace_context
+
     run_pass()  # warmup: compile (one program per bucket) + tokenizer cache
-    total, elapsed = run_pass()
+    # BENCH_PROFILE=<dir>: capture a jax.profiler trace of the timed pass
+    with trace_context(os.environ.get("BENCH_PROFILE")):
+        total, elapsed = run_pass()
     rps = total / elapsed
 
     # the baseline estimate is FLOP-derived at padded length 512 (the

@@ -4,7 +4,9 @@ The round-2 driver capture died with a transient 'Unable to initialize
 backend axon: UNAVAILABLE' at the first device op; the supervisor must
 retry that class of failure, kill hung attempts by process group, and
 emit exactly one JSON line on unrecoverable failure (never a traceback).
-No JAX is involved here — the children are tiny shell-level scripts.
+Most children are tiny shell-level scripts with no JAX; the
+``_wait_for_device`` / wedged-backend tests spawn jax-importing probe
+children (bounded budgets keep them fast either way).
 """
 
 import json
@@ -144,9 +146,9 @@ def test_exhausted_retries_report_last_error(tmp_path):
 
 
 def test_wait_for_device_succeeds_on_live_backend():
-    """This one probe child DOES import jax (CPU platform) — the only test
-    here that needs it; the budget allows ~2 probes so a JAX-less env
-    fails in bounded time rather than churning."""
+    """This probe child DOES import jax (CPU platform); the budget allows
+    ~2 probes so a JAX-less env fails in bounded time rather than
+    churning."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     assert _wait_for_device(90, probe_timeout=80, interval=0.1, env=env)
 
@@ -156,3 +158,20 @@ def test_wait_for_device_gives_up_on_dead_backend():
     DEVICE_OK) must exhaust the budget and return False, not loop forever."""
     env = dict(os.environ, JAX_PLATFORMS="no_such_platform")
     assert not _wait_for_device(1, probe_timeout=60, interval=0.1, env=env)
+
+
+def test_main_emits_error_json_when_device_never_answers(monkeypatch, capsys):
+    """The driver-facing contract under a wedged backend: exactly one JSON
+    line with an error field and rc=1 — never a hang or a traceback."""
+    from memvul_tpu.bench import main as bench_main
+
+    monkeypatch.setenv("JAX_PLATFORMS", "no_such_platform")
+    monkeypatch.setenv("BENCH_DEVICE_WAIT", "1")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "30")
+    rc = bench_main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 1
+    assert len(out) == 1
+    report = json.loads(out[0])
+    assert report["value"] == 0.0
+    assert "device did not answer" in report["error"]
