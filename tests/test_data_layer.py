@@ -250,6 +250,49 @@ def test_memory_reader_train_pairs(workspace):
     assert len(same) == 4 * n_pos
 
 
+def test_partner_text_mix_is_70_15_15(workspace):
+    """The matched-pair partner text follows the reference's sampling mix
+    (reader_memory.py:205-224): 70% partner's CVE description, 15% its
+    CWE anchor, 15% its own report text — the fixed-seed distributional
+    check SURVEY §4 calls for."""
+    reader = MemoryReader(
+        cve_path=workspace["paths"]["cve"],
+        anchor_path=workspace["paths"]["anchors"],
+        seed=0,
+    )
+    category = next(iter(workspace["anchors"]))
+    cve_id = next(
+        c for c, rec in reader._cve.items() if rec["CWE_ID"] == category
+    )
+    s = {"Issue_Url": "u1", "text": "SELF", "CVE_ID": cve_id, "CWE_ID": category}
+    partner = {
+        "Issue_Url": "u2",
+        "text": "PARTNER-REPORT-TEXT",
+        "CVE_ID": cve_id,
+        "CWE_ID": category,
+    }
+    cve_text = reader._cve_description(cve_id)
+    anchor_text = workspace["anchors"][category]
+    assert len({cve_text, anchor_text, partner["text"]}) == 3
+
+    n = 4000
+    counts = {"cve": 0, "anchor": 0, "report": 0}
+    for _ in range(n):
+        text = reader._partner_text(s, partner)
+        if text == cve_text:
+            counts["cve"] += 1
+        elif text == anchor_text:
+            counts["anchor"] += 1
+        else:
+            counts["report"] += 1
+    assert abs(counts["cve"] / n - 0.70) < 0.04, counts
+    assert abs(counts["anchor"] / n - 0.15) < 0.04, counts
+    assert abs(counts["report"] / n - 0.15) < 0.04, counts
+
+    # a positive partnered with itself always uses its CVE description
+    assert reader._partner_text(s, {**partner, "Issue_Url": "u1"}) == cve_text
+
+
 def test_memory_reader_resampling_differs_between_epochs(workspace):
     reader = MemoryReader(
         cve_path=workspace["paths"]["cve"],
