@@ -166,6 +166,45 @@ def cmd_build_data(args) -> int:
     return 0
 
 
+def cmd_analyze(args) -> int:
+    """The paper-analysis suite over a corpus JSON — keyword study, IR→CVE
+    disclosure-lag histogram, CWE-category ECDF, attack-step counts, repo
+    stats (reference: utils.py:415-572, run there by editing __main__)."""
+    from .data.analysis import (
+        count_attack_steps,
+        cumulative_cwe_distribution,
+        cwe_report_distribution,
+        delta_days_histogram,
+        join_positives_with_cve,
+        keyword_match_study,
+        repo_stats,
+    )
+
+    samples = json.loads(Path(args.corpus).read_text())
+    cve_dict = (
+        json.loads(Path(args.cve_dict).read_text()) if args.cve_dict else {}
+    )
+    report: dict = {"num_samples": len(samples)}
+    report["keyword_match"] = keyword_match_study(samples)
+    positives = join_positives_with_cve(samples, cve_dict)
+    report["attack_steps"] = count_attack_steps(positives)
+    # Published_Date rides on the records themselves when present;
+    # the CVE dict is only a fallback, so the histogram always runs
+    report["delta_days"] = delta_days_histogram(positives, cve_dict or None)
+    if cve_dict:
+        dist = cwe_report_distribution(positives)
+        report["cwe_cumulative"] = cumulative_cwe_distribution(dist)
+    if args.repo_info:
+        report["repo_stats"] = repo_stats(
+            samples, json.loads(Path(args.repo_info).read_text())
+        )
+    text = json.dumps(report, indent=2, default=float)
+    if args.out:
+        Path(args.out).write_text(text)
+    print(text)
+    return 0
+
+
 def cmd_bench(args) -> int:
     from .bench import main as bench_main
 
@@ -273,6 +312,13 @@ def main(argv=None) -> int:
                    help="also build the CWE-1000-scale bank (one anchor per "
                    "Research View node; pairs with model-axis bank sharding)")
     p.set_defaults(fn=cmd_build_data)
+
+    p = sub.add_parser("analyze", help="paper-analysis suite over a corpus JSON")
+    p.add_argument("corpus", help="corpus JSON (e.g. train_project.json)")
+    p.add_argument("--cve-dict", default=None, help="CVE_dict.json")
+    p.add_argument("--repo-info", default=None, help="repo star/fork info JSON")
+    p.add_argument("-o", "--out", default=None, help="write the report here too")
+    p.set_defaults(fn=cmd_analyze)
 
     p = sub.add_parser("bench", help="run the throughput benchmark")
     p.set_defaults(fn=cmd_bench)

@@ -174,6 +174,26 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
         assert auto_metrics[key] == pytest.approx(metrics[key], abs=1e-6), key
 
 
+def test_cli_analyze(ws, tmp_path):
+    """The paper-analysis suite as one CLI command (the reference edits
+    utils.py __main__ to run these)."""
+    out_path = tmp_path / "analysis.json"
+    rc = main([
+        "analyze", ws["paths"]["train"],
+        "--cve-dict", ws["paths"]["cve"], "-o", str(out_path),
+    ])
+    assert rc == 0
+    report = json.loads(out_path.read_text())
+    km = report["keyword_match"]
+    assert report["num_samples"] == sum(km.values()) > 0
+    assert report["attack_steps"]["total"] >= report["attack_steps"]["with_attack_steps"]
+    # the histogram actually matched records (not just static labels)
+    assert report["delta_days"]["total"] > 0
+    assert sum(report["delta_days"]["counts"]) == report["delta_days"]["total"]
+    # ECDF ends at fraction 1.0
+    assert report["cwe_cumulative"][-1][1] == pytest.approx(1.0)
+
+
 def test_cli_train_single_classifier(ws, tmp_path):
     config = {
         "random_seed": 2021,
