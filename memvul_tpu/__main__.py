@@ -81,6 +81,9 @@ def cmd_pretrain(args) -> int:
     from .config import load_config
     from .pretrain.mlm import MLMTrainer, MLMTrainerConfig
 
+    if args.export_hf:
+        import torch  # noqa: F401 — fail fast, not after hours of training
+
     config = load_config(args.config, overrides=args.overrides)
     tokenizer = build_tokenizer(config.get("tokenizer"))
     bert_cfg = encoder_config(config.get("encoder"), tokenizer.vocab_size)
@@ -89,8 +92,16 @@ def cmd_pretrain(args) -> int:
     )
     result = trainer.train(config["train_data_path"])
     out_dir = Path(config.get("output_dir", "further_pretrain/out_wwm"))
-    path = save_encoder_checkpoint(trainer.encoder_params(), out_dir)
-    print(json.dumps({"final_loss": result["final_loss"], "checkpoint": str(path)}))
+    encoder = trainer.encoder_params()  # one device fetch, shared below
+    path = save_encoder_checkpoint(encoder, out_dir)
+    report = {"final_loss": result["final_loss"], "checkpoint": str(path)}
+    if args.export_hf:
+        from .build import export_hf_checkpoint
+
+        report["hf_checkpoint"] = str(
+            export_hf_checkpoint(encoder, bert_cfg, out_dir / "hf")
+        )
+    print(json.dumps(report))
     return 0
 
 
@@ -293,6 +304,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("pretrain", help="MLM further-pretraining")
     p.add_argument("config")
     p.add_argument("-o", "--overrides", default=None)
+    p.add_argument("--export-hf", action="store_true",
+                   help="also write an HF-format checkpoint dir the "
+                   "reference's AutoModel.from_pretrained consumes")
     p.set_defaults(fn=cmd_pretrain)
 
     p = sub.add_parser("baseline", help="sklearn baselines")

@@ -134,6 +134,44 @@ def save_encoder_checkpoint(encoder_params, out_dir: Union[str, Path]) -> Path:
     return path
 
 
+def export_hf_checkpoint(
+    bert_subtree, config, out_dir: Union[str, Path]
+) -> Path:
+    """Write an encoder as an HF-format checkpoint dir (config.json +
+    pytorch_model.bin) that ``AutoModel.from_pretrained`` loads — so an
+    encoder further-pretrained HERE plugs into the reference's embedder
+    (custom_PTM_embedder.py:80,95-99) unchanged.  The inverse direction
+    (reference/HF → Flax) is models/convert.py:convert_bert_state_dict."""
+    import torch
+
+    from .models.convert import export_bert_state_dict
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    sd = export_bert_state_dict(bert_subtree, None, config)
+    torch.save(
+        {k: torch.tensor(v) for k, v in sd.items()},
+        out_dir / "pytorch_model.bin",
+    )
+    (out_dir / "config.json").write_text(json.dumps({
+        "model_type": "bert",
+        "architectures": ["BertModel"],
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "intermediate_size": config.intermediate_size,
+        "max_position_embeddings": config.max_position_embeddings,
+        "hidden_act": "gelu",
+        "layer_norm_eps": config.layer_norm_eps,
+        "hidden_dropout_prob": config.hidden_dropout,
+        "attention_probs_dropout_prob": config.attention_dropout,
+        "pad_token_id": 0,
+        "type_vocab_size": config.type_vocab_size,
+    }, indent=2))
+    return out_dir
+
+
 def _tokenizer_file(tok_cfg: Optional[Dict[str, Any]]) -> Optional[str]:
     """The file to embed in the archive — MUST mirror the selection
     precedence of ``WordPieceTokenizer.__init__`` (an existing vocab.txt

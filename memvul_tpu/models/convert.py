@@ -127,6 +127,77 @@ def convert_bert_state_dict(
     return bert_subtree, pooler
 
 
+def _unstack_layers(encoder: Dict, config: BertConfig) -> list:
+    """Per-layer param trees, whether scan-stacked or expanded."""
+    import jax
+
+    if config.scan_layers:
+        stacked = encoder["layers"]["layer"]
+        return [
+            jax.tree_util.tree_map(lambda x: np.asarray(x)[i], stacked)
+            for i in range(config.num_layers)
+        ]
+    return [encoder[f"layer_{i}"] for i in range(config.num_layers)]
+
+
+def export_bert_state_dict(
+    bert_subtree: Dict, pooler: Optional[Dict], config: BertConfig
+) -> Dict[str, np.ndarray]:
+    """The inverse of :func:`convert_bert_state_dict`: Flax encoder (+
+    optional pooler) → an HF ``BertModel``-keyed state dict.
+
+    Completes bidirectional interop with the reference stack: models
+    further-pretrained or fine-tuned here export to the checkpoint layout
+    the reference's ``AutoModel.from_pretrained`` consumes
+    (custom_PTM_embedder.py:95-99).  Round-trip identity with the import
+    direction is pinned by tests/test_convert_parity.py."""
+    h, heads = config.hidden_size, config.num_heads
+    emb = bert_subtree["embeddings"]
+    sd: Dict[str, np.ndarray] = {
+        "embeddings.word_embeddings.weight": emb["word_embeddings"]["embedding"],
+        "embeddings.position_embeddings.weight": emb["position_embeddings"][
+            "embedding"
+        ],
+        "embeddings.token_type_embeddings.weight": emb["token_type_embeddings"][
+            "embedding"
+        ],
+        "embeddings.LayerNorm.weight": emb["LayerNorm"]["scale"],
+        "embeddings.LayerNorm.bias": emb["LayerNorm"]["bias"],
+    }
+    for i, layer in enumerate(_unstack_layers(bert_subtree["encoder"], config)):
+        p = f"encoder.layer.{i}."
+        attn = layer["attention"]
+        for name in ("query", "key", "value"):
+            sd[p + f"attention.self.{name}.weight"] = _t(
+                np.asarray(attn[name]["kernel"]).reshape(h, h)
+            )
+            sd[p + f"attention.self.{name}.bias"] = np.asarray(
+                attn[name]["bias"]
+            ).reshape(h)
+        sd[p + "attention.output.dense.weight"] = _t(
+            np.asarray(attn["output"]["kernel"]).reshape(h, h)
+        )
+        sd[p + "attention.output.dense.bias"] = np.asarray(attn["output"]["bias"])
+        sd[p + "attention.output.LayerNorm.weight"] = np.asarray(
+            attn["output_LayerNorm"]["scale"]
+        )
+        sd[p + "attention.output.LayerNorm.bias"] = np.asarray(
+            attn["output_LayerNorm"]["bias"]
+        )
+        sd[p + "intermediate.dense.weight"] = _t(np.asarray(layer["intermediate"]["kernel"]))
+        sd[p + "intermediate.dense.bias"] = np.asarray(layer["intermediate"]["bias"])
+        sd[p + "output.dense.weight"] = _t(np.asarray(layer["output"]["kernel"]))
+        sd[p + "output.dense.bias"] = np.asarray(layer["output"]["bias"])
+        sd[p + "output.LayerNorm.weight"] = np.asarray(
+            layer["output_LayerNorm"]["scale"]
+        )
+        sd[p + "output.LayerNorm.bias"] = np.asarray(layer["output_LayerNorm"]["bias"])
+    if pooler is not None:
+        sd["pooler.dense.weight"] = _t(np.asarray(pooler["dense"]["kernel"]))
+        sd["pooler.dense.bias"] = np.asarray(pooler["dense"]["bias"])
+    return {k: np.asarray(v, np.float32) for k, v in sd.items()}
+
+
 def load_into_classifier(classifier_params, state_dict, config: BertConfig):
     """Return classifier params with the encoder (and pooler, if present)
     replaced by converted torch weights."""

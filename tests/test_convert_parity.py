@@ -149,6 +149,66 @@ def _synthetic_bert_state_dict(
     return sd
 
 
+@pytest.mark.parametrize("scan", [False, True])
+def test_export_round_trips_through_import(hf_bert, scan):
+    """flax → HF state dict → flax is the identity (both layer layouts) —
+    the export direction of the bidirectional interop."""
+    from memvul_tpu.models.convert import export_bert_state_dict
+
+    cfg = CFG.replace(scan_layers=scan)
+    sd = {k: v.detach().numpy() for k, v in hf_bert.state_dict().items()}
+    bert, pooler = convert_bert_state_dict(sd, cfg)
+    exported = export_bert_state_dict(bert, pooler, cfg)
+    bert2, pooler2 = convert_bert_state_dict(exported, cfg)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path((bert, pooler)),
+        jax.tree_util.tree_leaves_with_path((bert2, pooler2)),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_loads_into_hf_bert_strict(hf_bert):
+    """The exported dict loads into a real transformers BertModel with
+    every model parameter matched — exact HF-name/shape compatibility,
+    i.e. the reference's AutoModel.from_pretrained consumes it."""
+    from memvul_tpu.models.convert import export_bert_state_dict
+
+    sd = {k: v.detach().numpy() for k, v in hf_bert.state_dict().items()}
+    bert, pooler = convert_bert_state_dict(sd, CFG)
+    exported = {
+        k: torch.tensor(v) for k, v in export_bert_state_dict(bert, pooler, CFG).items()
+    }
+    fresh = transformers.BertModel(hf_bert.config)
+    missing, unexpected = fresh.load_state_dict(exported, strict=False)
+    assert not unexpected, unexpected
+    # only non-parameter buffers (e.g. position_ids) may be absent
+    assert all("position_ids" in k for k in missing), missing
+    # and the loaded model reproduces the original's forward exactly
+    ids = np.arange(12, dtype=np.int64)[None, :] + 5
+    with torch.no_grad():
+        a = hf_bert(torch.tensor(ids)).last_hidden_state.numpy()
+        b = fresh.eval()(torch.tensor(ids)).last_hidden_state.numpy()
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_export_hf_checkpoint_loads_with_from_pretrained(tmp_path, hf_bert):
+    """export_hf_checkpoint writes a dir AutoModel.from_pretrained loads
+    offline — the reference's embedder consumes encoders pretrained here
+    (custom_PTM_embedder.py:80,95-99)."""
+    from memvul_tpu.build import export_hf_checkpoint
+
+    sd = {k: v.detach().numpy() for k, v in hf_bert.state_dict().items()}
+    bert, _ = convert_bert_state_dict(sd, CFG)
+    out = export_hf_checkpoint(bert, CFG, tmp_path / "hf")
+    loaded = transformers.AutoModel.from_pretrained(str(out)).eval()
+    ids = torch.tensor(np.arange(12, dtype=np.int64)[None, :] + 5)
+    with torch.no_grad():
+        a = hf_bert(ids).last_hidden_state.numpy()
+        b = loaded(ids).last_hidden_state.numpy()
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
 def test_base_geometry_conversion_shapes():
     """A bert-base-sized reference state dict must convert into the
     scan-stacked param tree name-for-name and shape-for-shape, with NO
