@@ -99,7 +99,9 @@ def cmd_pretrain(args) -> int:
         from .build import export_hf_checkpoint
 
         report["hf_checkpoint"] = str(
-            export_hf_checkpoint(encoder, bert_cfg, out_dir / "hf")
+            export_hf_checkpoint(
+                encoder, bert_cfg, out_dir / "hf", tokenizer=tokenizer
+            )
         )
     print(json.dumps(report))
     return 0

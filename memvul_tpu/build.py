@@ -135,11 +135,12 @@ def save_encoder_checkpoint(encoder_params, out_dir: Union[str, Path]) -> Path:
 
 
 def export_hf_checkpoint(
-    bert_subtree, config, out_dir: Union[str, Path]
+    bert_subtree, config, out_dir: Union[str, Path], tokenizer=None
 ) -> Path:
     """Write an encoder as an HF-format checkpoint dir (config.json +
-    pytorch_model.bin) that ``AutoModel.from_pretrained`` loads — so an
-    encoder further-pretrained HERE plugs into the reference's embedder
+    pytorch_model.bin, plus vocab.txt when a tokenizer is given) that
+    ``AutoModel.from_pretrained`` loads — so an encoder further-pretrained
+    HERE plugs into the reference's embedder
     (custom_PTM_embedder.py:80,95-99) unchanged.  The inverse direction
     (reference/HF → Flax) is models/convert.py:convert_bert_state_dict."""
     import torch
@@ -169,6 +170,13 @@ def export_hf_checkpoint(
         "pad_token_id": 0,
         "type_vocab_size": config.type_vocab_size,
     }, indent=2))
+    if tokenizer is not None:
+        if not hasattr(tokenizer, "save_vocab_txt"):
+            raise TypeError(
+                f"{type(tokenizer).__name__} cannot export a bert vocab.txt "
+                "— HF export needs the wordpiece tokenizer"
+            )
+        tokenizer.save_vocab_txt(out_dir / "vocab.txt")
     return out_dir
 
 

@@ -199,6 +199,18 @@ class WordPieceTokenizer(TextTokenizer):
     def save(self, path: Union[str, Path]) -> None:
         self._tok.save(str(path))
 
+    def save_vocab_txt(self, path: Union[str, Path]) -> None:
+        """Write the vocabulary as a bert-style ``vocab.txt`` (one token
+        per line, in id order) — the file HF's ``BertTokenizer`` and the
+        reference's configs consume (MemVul/config_memory.json:16-20)."""
+        ordered = sorted(self._tok.get_vocab().items(), key=lambda kv: kv[1])
+        ids = [i for _, i in ordered]
+        if ids != list(range(len(ordered))):
+            raise ValueError(f"vocab ids are not contiguous 0..{len(ordered)-1}")
+        Path(path).write_text(
+            "\n".join(t for t, _ in ordered) + "\n", encoding="utf-8"
+        )
+
 
 @TextTokenizer.register("word")
 class WordTokenizer(TextTokenizer):
@@ -296,11 +308,13 @@ def _bert_tokenizer_from_vocab(vocab_path: str, lowercase: bool):
     from tokenizers.models import WordPiece as _WordPiece
 
     if vocab_path.endswith(".json"):
-        vocab = json.loads(Path(vocab_path).read_text())
+        vocab = json.loads(Path(vocab_path).read_text(encoding="utf-8"))
     else:
         vocab = {
             line.rstrip("\n"): i
-            for i, line in enumerate(Path(vocab_path).read_text().splitlines())
+            for i, line in enumerate(
+                Path(vocab_path).read_text(encoding="utf-8").splitlines()
+            )
         }
     tok = _FastTokenizer(_WordPiece(vocab, unk_token=UNK))
     _apply_bert_pretokenization(tok, lowercase)

@@ -73,6 +73,17 @@ def pair(vocab_file):
     return hf, ours
 
 
+def test_save_vocab_txt_matches_bert_format(vocab_file, tmp_path):
+    """save_vocab_txt reproduces the bert vocab.txt format byte-for-byte
+    (the fixture above hand-rolls the format as the independent spec) —
+    this is the file the HF-export dir ships for the reference's
+    BertTokenizer."""
+    ours = WordPieceTokenizer(vocab_path=vocab_file)
+    out = tmp_path / "vocab.txt"
+    ours.save_vocab_txt(out)
+    assert out.read_text() == Path(vocab_file).read_text()
+
+
 def test_golden_corpus_id_parity(pair):
     """Every normalized golden document tokenizes to identical ids."""
     hf, ours = pair
