@@ -136,3 +136,42 @@ def test_heading_and_emphasis_markers_removed():
 def test_html_comment_removed():
     out = normalize_text("a <!--- hidden ---> b")
     assert "hidden" not in out
+
+
+def test_leak_guard_property_random_contexts():
+    """The normalizer's security property: no CVE/CWE identifier or
+    mitre/bugzilla reference survives normalization, wherever it appears
+    (reference leak guard: MemVul/util.py:85-90,102-104).  Randomized
+    contexts — headings, code fences, links, sentences, paths — seeded
+    for determinism."""
+    import random
+    import re
+
+    rng = random.Random(2021)
+    contexts = [
+        "see {} for details",
+        "# {} fixed\nbody text",
+        "`{}`",
+        "```\n{}\n```",
+        "[link]({})",
+        "reported in {} and elsewhere",
+        "a/b/{}/c.txt",
+        "{}: heap overflow",
+        "prefix{}suffix",
+        "*{}*",
+        "> quoted {} here",
+    ]
+    idents = [
+        lambda: f"CVE-{rng.randint(1999, 2030)}-{rng.randint(1, 99999)}",
+        lambda: f"CWE-{rng.randint(1, 1400)}",
+        lambda: (
+            "https://cve.mitre.org/cgi-bin/cvename.cgi?name="
+            f"CVE-{rng.randint(1999, 2030)}-{rng.randint(1, 99999)}"
+        ),
+        lambda: f"https://bugzilla.redhat.com/show_bug.cgi?id={rng.randint(1, 9_999_999)}",
+    ]
+    leak = re.compile(r"CVE-[0-9]|CWE-[0-9]|mitre\.org|bugzilla")
+    for _ in range(300):
+        text = rng.choice(contexts).format(rng.choice(idents)())
+        out = normalize_text(text)
+        assert not leak.search(out), (text, out)
