@@ -448,6 +448,8 @@ class MLMTrainer:
                 self.checkpointer.save(
                     epoch, self._state_dict(epoch), metadata={"loss": mean_loss}
                 )
+        if self.checkpointer is not None:
+            self.checkpointer.flush()  # final async save must land on disk
         return {"final_loss": history[-1] if history else 0.0, "history": history}
 
     def encoder_params(self):

@@ -30,6 +30,7 @@ class TrainCheckpointer:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self._best_ckptr = ocp.StandardCheckpointer()
         self._best_dir = self.directory / "best"
 
     # -- per-epoch state -----------------------------------------------------
@@ -41,29 +42,49 @@ class TrainCheckpointer:
         is_best: bool = False,
         metadata: Optional[Dict[str, Any]] = None,
     ) -> None:
+        """Asynchronous: the disk write overlaps the next training epoch.
+
+        The caller passes HOST-resident state (the trainer device_gets
+        before calling), so nothing here races device buffer donation;
+        in-flight writes from the previous epoch are flushed first, and
+        every read path (restore/latest_step) flushes before touching
+        disk.  Orbax commits via tmp-dir rename, so a crash mid-write
+        leaves the previous checkpoint intact."""
+        self.flush()
         self._manager.save(step, args=ocp.args.StandardSave(state))
-        self._manager.wait_until_finished()
         if metadata is not None:
             (self.directory / f"metrics_epoch_{step}.json").write_text(
                 json.dumps(metadata, indent=2, default=float)
             )
         if is_best:
-            ckptr = ocp.StandardCheckpointer()
-            best_path = self._best_dir
-            if best_path.exists():
-                import shutil
+            # the best checkpoint swaps ATOMICALLY: write the replacement
+            # beside the old one, wait for it to commit, then swap — at
+            # every instant a committed best exists on disk (the epoch
+            # save above stays async; best epochs are the minority)
+            import shutil
 
-                shutil.rmtree(best_path)
-            ckptr.save(best_path, state)
-            ckptr.wait_until_finished()
+            tmp = self.directory / "best_tmp"
+            if tmp.exists():
+                shutil.rmtree(tmp)
+            self._best_ckptr.save(tmp, state)
+            self._best_ckptr.wait_until_finished()
+            if self._best_dir.exists():
+                shutil.rmtree(self._best_dir)
+            tmp.rename(self._best_dir)
+
+    def flush(self) -> None:
+        """Block until all in-flight checkpoint writes are committed."""
+        self._manager.wait_until_finished()
+        self._best_ckptr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
+        self.flush()
         return self._manager.latest_step()
 
     def restore_latest(
         self, template: Dict[str, Any]
     ) -> Optional[Tuple[int, Dict[str, Any]]]:
-        step = self._manager.latest_step()
+        step = self.latest_step()  # flushes in-flight writes
         if step is None:
             return None
         restored = self._manager.restore(
@@ -72,13 +93,15 @@ class TrainCheckpointer:
         return step, restored
 
     def restore_best(self, template: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        self.flush()
         if not self._best_dir.exists():
             return None
-        ckptr = ocp.StandardCheckpointer()
-        return ckptr.restore(self._best_dir, template)
+        return self._best_ckptr.restore(self._best_dir, template)
 
     def close(self) -> None:
+        self.flush()
         self._manager.close()
+        self._best_ckptr.close()
 
 
 class MetricTracker:

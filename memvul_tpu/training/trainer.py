@@ -441,6 +441,8 @@ class MemoryTrainer:
             if val and self.tracker.should_stop():
                 logger.info("early stopping at epoch %d", self.epoch)
                 break
+        if self.checkpointer is not None:
+            self.checkpointer.flush()  # final async save must land on disk
         return {
             "best_epoch": self.tracker.best_epoch,
             "best_validation": self.tracker.best,
