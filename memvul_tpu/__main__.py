@@ -85,6 +85,12 @@ def cmd_pretrain(args) -> int:
         import torch  # noqa: F401 — fail fast, not after hours of training
 
     config = load_config(args.config, overrides=args.overrides)
+    val_path = config.get("validation_data_path")
+    if val_path and not Path(val_path).exists():
+        # fail fast, not after hours of training (same rationale as the
+        # torch probe above)
+        print(f"validation_data_path {val_path} does not exist", file=sys.stderr)
+        return 2
     tokenizer = build_tokenizer(config.get("tokenizer"))
     bert_cfg = encoder_config(config.get("encoder"), tokenizer.vocab_size)
     trainer = MLMTrainer(
@@ -95,6 +101,9 @@ def cmd_pretrain(args) -> int:
     encoder = trainer.encoder_params()  # one device fetch, shared below
     path = save_encoder_checkpoint(encoder, out_dir)
     report = {"final_loss": result["final_loss"], "checkpoint": str(path)}
+    if config.get("validation_data_path"):
+        # the reference script's do_eval path (run_mlm_wwm.py:386-397)
+        report.update(trainer.evaluate(val_path))
     if args.export_hf:
         from .build import export_hf_checkpoint
 

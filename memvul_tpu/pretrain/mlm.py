@@ -133,13 +133,21 @@ class MLMModel(nn.Module):
         return logits + bias.astype(logits.dtype)
 
 
-def mlm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean CE over positions with labels != IGNORE."""
+def mlm_nll_sums(logits: jax.Array, labels: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(summed NLL over supervised positions, supervised-position count)
+    — the one implementation of the masked-LM numerics, shared by the
+    training loss (mean) and held-out evaluation (corpus-weighted)."""
     mask = (labels != IGNORE).astype(jnp.float32)
     safe_labels = jnp.where(labels == IGNORE, 0, labels)
     log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(log_probs, safe_labels[..., None], axis=-1)[..., 0]
-    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum(), mask.sum()
+
+
+def mlm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over positions with labels != IGNORE."""
+    nll_sum, count = mlm_nll_sums(logits, labels)
+    return nll_sum / jnp.maximum(count, 1.0)
 
 
 # -- params plumbing ---------------------------------------------------------
@@ -381,19 +389,81 @@ class MLMTrainer:
         order = rng.permutation(n)
         for start in range(0, n, rows):
             picked = order[start : start + rows]
-            ids = np.full((rows, c.max_length), self.tokenizer.pad_id, np.int32)
-            mask = np.zeros_like(ids)
-            for i, idx in enumerate(picked):
-                seq = self._flat_ids[self._offsets[idx] : self._offsets[idx + 1]]
-                ids[i, : len(seq)] = seq
-                mask[i, : len(seq)] = 1
-            masked, labels = whole_word_mask(
-                ids, mask, rng, self.tokenizer.mask_id,
-                self.tokenizer.vocab_size, self._continuation, self._special,
-                c.mask_prob,
-            )
+            seqs = [
+                self._flat_ids[self._offsets[idx] : self._offsets[idx + 1]]
+                for idx in picked
+            ]
+            masked, mask, labels = self._masked_rows(seqs, rows, rng)
             shape = (max(1, c.grad_accum), c.batch_size, c.max_length)
             yield masked.reshape(shape), mask.reshape(shape), labels.reshape(shape)
+
+    def _masked_rows(
+        self, seqs: List[np.ndarray], rows: int, rng: np.random.Generator
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(masked ids, attention mask, labels) for up to ``rows`` padded
+        sequences — the one batch-construction path shared by training
+        (`_batches`) and held-out evaluation, so their losses stay
+        comparable."""
+        c = self.c
+        ids = np.full((rows, c.max_length), self.tokenizer.pad_id, np.int32)
+        mask = np.zeros_like(ids)
+        for i, seq in enumerate(seqs):
+            ids[i, : len(seq)] = seq
+            mask[i, : len(seq)] = 1
+        masked, labels = whole_word_mask(
+            ids, mask, rng, self.tokenizer.mask_id,
+            self.tokenizer.vocab_size, self._continuation, self._special,
+            c.mask_prob,
+        )
+        return masked, mask, labels
+
+    def evaluate(
+        self, corpus_path: str, params=None, seed: int = 0
+    ) -> Dict[str, float]:
+        """Held-out masked-LM loss + perplexity (the reference script's
+        ``do_eval`` path, run_mlm_wwm.py:386-397).  Masking is drawn from
+        a fixed ``seed`` so the metric is reproducible; the mean is
+        weighted by masked-token count, not per-batch."""
+        import math
+
+        c = self.c
+        params = self.params if params is None else params
+        lines = [
+            l.strip() for l in open(corpus_path, encoding="utf-8") if l.strip()
+        ]
+        if not lines:
+            raise ValueError(f"MLM eval corpus {corpus_path} is empty")
+
+        if not hasattr(self, "_eval_sums"):
+            def eval_sums(p, ids, mask, labels):
+                logits = self.model.apply(p, ids, mask, deterministic=True)
+                return mlm_nll_sums(logits, labels)
+
+            self._eval_sums = jax.jit(eval_sums)  # compiled once per trainer
+
+        rng = np.random.default_rng(seed)
+        rows = c.batch_size
+        nll_total = 0.0
+        masked_total = 0.0
+        for start in range(0, len(lines), rows):
+            seqs = [
+                np.asarray(
+                    self.tokenizer.encode(text, max_length=c.max_length),
+                    np.int32,
+                )
+                for text in lines[start : start + rows]
+            ]
+            masked, mask, labels = self._masked_rows(seqs, rows, rng)
+            s, k = self._eval_sums(params, masked, mask, labels)
+            nll_total += float(s)
+            masked_total += float(k)
+        loss = nll_total / max(masked_total, 1.0)
+        return {
+            "eval_loss": loss,
+            "perplexity": math.exp(min(loss, 30.0)),
+            "eval_lines": len(lines),
+            "masked_tokens": int(masked_total),
+        }
 
     def train(self, corpus_path: str) -> Dict[str, float]:
         from ..data.batching import prefetch

@@ -130,8 +130,25 @@ def test_mlm_training_reduces_loss_and_transplants(ws, tmp_path):
             learning_rate=3e-3, warmup_steps=2,
         ),
     )
+    # held-out eval before training (reference do_eval, run_mlm_wwm.py:386-397):
+    # deterministic for a fixed seed, perplexity == exp(loss)
+    import math
+
+    held_out = tmp_path / "mlm_eval.txt"
+    eval_reports, _ = generate_corpus(seed=9)
+    held_out.write_text("\n".join(corpus_texts(eval_reports)[:24]))
+    before = trainer.evaluate(str(held_out), seed=4)
+    assert before == trainer.evaluate(str(held_out), seed=4)
+    assert before["perplexity"] == pytest.approx(
+        math.exp(before["eval_loss"]), rel=1e-6
+    )
+    assert before["masked_tokens"] > 0
+
     out = trainer.train(str(corpus))
     assert out["history"][-1] < out["history"][0]
+    # training on in-domain text lowers held-out masked-LM loss
+    after = trainer.evaluate(str(held_out), seed=4)
+    assert after["eval_loss"] < before["eval_loss"]
 
     # encoder subtree transplants into the classifier
     from memvul_tpu.models import MemoryModel
