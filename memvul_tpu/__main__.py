@@ -86,11 +86,16 @@ def cmd_pretrain(args) -> int:
 
     config = load_config(args.config, overrides=args.overrides)
     val_path = config.get("validation_data_path")
-    if val_path and not Path(val_path).exists():
-        # fail fast, not after hours of training (same rationale as the
-        # torch probe above)
-        print(f"validation_data_path {val_path} does not exist", file=sys.stderr)
-        return 2
+    if val_path:
+        # fail fast on a missing OR empty eval corpus, not after hours of
+        # training (same rationale as the torch probe above)
+        from .pretrain.mlm import read_corpus_lines
+
+        try:
+            read_corpus_lines(val_path)
+        except (OSError, ValueError) as e:
+            print(f"validation_data_path unusable: {e}", file=sys.stderr)
+            return 2
     tokenizer = build_tokenizer(config.get("tokenizer"))
     bert_cfg = encoder_config(config.get("encoder"), tokenizer.vocab_size)
     trainer = MLMTrainer(
@@ -101,7 +106,7 @@ def cmd_pretrain(args) -> int:
     encoder = trainer.encoder_params()  # one device fetch, shared below
     path = save_encoder_checkpoint(encoder, out_dir)
     report = {"final_loss": result["final_loss"], "checkpoint": str(path)}
-    if config.get("validation_data_path"):
+    if val_path:
         # the reference script's do_eval path (run_mlm_wwm.py:386-397)
         report.update(trainer.evaluate(val_path))
     if args.export_hf:

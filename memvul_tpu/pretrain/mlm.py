@@ -211,6 +211,17 @@ class MLMTrainerConfig:
     prefetch_depth: int = 4
 
 
+def read_corpus_lines(path) -> List[str]:
+    """Non-blank corpus lines; raises on an effectively-empty file.
+    Shared by training, held-out evaluation, and the CLI's fail-fast
+    validation check so all three agree on what 'empty' means."""
+    with open(path, encoding="utf-8") as f:
+        lines = [l.strip() for l in f if l.strip()]
+    if not lines:
+        raise ValueError(f"MLM corpus {path} is empty")
+    return lines
+
+
 class MLMTrainer:
     def __init__(
         self,
@@ -428,11 +439,7 @@ class MLMTrainer:
 
         c = self.c
         params = self.params if params is None else params
-        lines = [
-            l.strip() for l in open(corpus_path, encoding="utf-8") if l.strip()
-        ]
-        if not lines:
-            raise ValueError(f"MLM eval corpus {corpus_path} is empty")
+        lines = read_corpus_lines(corpus_path)
 
         if not hasattr(self, "_eval_sums"):
             def eval_sums(p, ids, mask, labels):
@@ -469,11 +476,7 @@ class MLMTrainer:
         from ..data.batching import prefetch
 
         c = self.c
-        lines = [
-            l.strip() for l in open(corpus_path, encoding="utf-8") if l.strip()
-        ]
-        if not lines:
-            raise ValueError(f"MLM corpus {corpus_path} is empty")
+        lines = read_corpus_lines(corpus_path)
         logger.info("MLM corpus: %d lines", len(lines))
         self._encode_corpus(lines)
         self.maybe_restore()

@@ -174,6 +174,45 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
         assert auto_metrics[key] == pytest.approx(metrics[key], abs=1e-6), key
 
 
+def test_cli_pretrain_with_eval_and_hf_export(ws, tmp_path, capsys):
+    """cmd_pretrain end-to-end: tiny MLM run + held-out eval
+    (validation_data_path → eval_loss/perplexity in the report) + HF
+    export dir with model, config, and vocab.txt."""
+    from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+
+    reports, _ = generate_corpus(seed=4)
+    texts = corpus_texts(reports)
+    train_txt = tmp_path / "mlm.txt"
+    train_txt.write_text("\n".join(texts[:48]))
+    val_txt = tmp_path / "mlm_val.txt"
+    val_txt.write_text("\n".join(texts[48:64]))
+    config = {
+        "tokenizer": {"type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"]},
+        "encoder": {"preset": "tiny"},
+        "train_data_path": str(train_txt),
+        "validation_data_path": str(val_txt),
+        "output_dir": str(tmp_path / "out_wwm"),
+        "trainer": {
+            "batch_size": 4, "grad_accum": 1, "max_length": 32,
+            "num_epochs": 1, "steps_per_epoch": 2, "warmup_steps": 1,
+        },
+    }
+    cfg_path = tmp_path / "pretrain.json"
+    cfg_path.write_text(json.dumps(config))
+    rc = main(["pretrain", str(cfg_path), "--export-hf"])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert np.isfinite(report["final_loss"])
+    assert report["eval_loss"] > 0 and report["masked_tokens"] > 0
+    hf = Path(report["hf_checkpoint"])
+    for name in ("pytorch_model.bin", "config.json", "vocab.txt"):
+        assert (hf / name).exists(), name
+    # a missing validation file fails fast (before training)
+    bad = dict(config, validation_data_path=str(tmp_path / "nope.txt"))
+    cfg_path.write_text(json.dumps(bad))
+    assert main(["pretrain", str(cfg_path)]) == 2
+
+
 def test_cli_analyze(ws, tmp_path):
     """The paper-analysis suite as one CLI command (the reference edits
     utils.py __main__ to run these)."""
