@@ -43,6 +43,31 @@ def test_shipped_configs_parse():
         assert isinstance(cfg, dict) and cfg
 
 
+def test_shipped_trainer_blocks_construct_their_dataclasses():
+    """Every shipped train config's trainer block must construct its
+    trainer dataclass — catches config/dataclass drift (an unknown key
+    in JSON raises TypeError here instead of at training time)."""
+    from memvul_tpu.pretrain.mlm import MLMTrainerConfig
+    from memvul_tpu.training.single_trainer import ClassifierTrainerConfig
+    from memvul_tpu.training.trainer import TrainerConfig
+
+    checked = 0
+    for f in sorted(CONFIGS_DIR.glob("*.json")):
+        cfg = loads_config(f.read_text())
+        trainer = dict(cfg.get("trainer") or {})
+        model_type = (cfg.get("model") or {}).get("type", "")
+        if f.name.startswith("further"):
+            MLMTrainerConfig(**trainer)
+        elif model_type in ("model_single", "model_cnn"):
+            ClassifierTrainerConfig(**trainer)
+        elif model_type == "model_memory":
+            TrainerConfig(**trainer)
+        else:
+            continue  # test-time override fragments have no trainer block
+        checked += 1
+    assert checked >= 8
+
+
 def test_encoder_config_dtype_and_preset():
     cfg = encoder_config({"preset": "tiny", "dtype": "bfloat16"}, vocab_size=777)
     assert cfg.dtype == jnp.bfloat16
