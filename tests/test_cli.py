@@ -55,15 +55,17 @@ def test_shipped_trainer_blocks_construct_their_dataclasses():
     for f in sorted(CONFIGS_DIR.glob("*.json")):
         cfg = loads_config(f.read_text())
         trainer = dict(cfg.get("trainer") or {})
-        model_type = (cfg.get("model") or {}).get("type", "")
+        if not trainer:
+            continue  # test-time override fragments have no trainer block
+        # mirror build.py's dispatch exactly: further_pretrain → MLM,
+        # model.type defaults to model_memory, everything else classifier
+        model_type = (cfg.get("model") or {}).get("type", "model_memory")
         if f.name.startswith("further"):
             MLMTrainerConfig(**trainer)
-        elif model_type in ("model_single", "model_cnn"):
-            ClassifierTrainerConfig(**trainer)
         elif model_type == "model_memory":
             TrainerConfig(**trainer)
         else:
-            continue  # test-time override fragments have no trainer block
+            ClassifierTrainerConfig(**trainer)
         checked += 1
     assert checked >= 8
 
