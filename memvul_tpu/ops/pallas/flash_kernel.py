@@ -255,9 +255,9 @@ def flash_attention(
     logic anywhere.
 
     Default blocks (512, 1024) come from an on-chip sweep (v5e, bf16,
-    B=4 H=12 D=64): 2.5-3.0x over the XLA formulation at 2k-4k tokens,
-    vs 0.7x at the naive (256, 256) — see SMOKE.md.  Blocks clamp to the
-    actual sequence length for shorter inputs.
+    B=4 H=12 D=64): 2.2-2.8x over the XLA formulation at 1k-4k tokens,
+    vs 0.7x at the naive (256, 256) — see SMOKE.md / TPU_PROOFS.json.
+    Blocks clamp to the actual sequence length for shorter inputs.
     """
     if query.ndim != 4:
         raise ValueError(f"expected [B, T, H, D], got {query.shape}")

@@ -57,20 +57,45 @@ class TrainCheckpointer:
                 json.dumps(metadata, indent=2, default=float)
             )
         if is_best:
-            # the best checkpoint swaps ATOMICALLY: write the replacement
-            # beside the old one, wait for it to commit, then swap — at
-            # every instant a committed best exists on disk (the epoch
-            # save above stays async; best epochs are the minority)
+            # the best checkpoint swaps via rename-aside: write the
+            # replacement beside the old one, wait for it to commit, move
+            # the old best aside, rename the new one into place, then
+            # delete the old copy — a crash at any point leaves a
+            # committed best on disk under ``best``, ``best_tmp`` or
+            # ``best_old``, and ``_recover_best`` promotes the newest (the
+            # epoch save above stays async; best epochs are the minority)
             import shutil
 
             tmp = self.directory / "best_tmp"
-            if tmp.exists():
-                shutil.rmtree(tmp)
+            old = self.directory / "best_old"
+            self._recover_best()
+            for stale in (tmp, old):
+                if stale.exists():
+                    shutil.rmtree(stale)
             self._best_ckptr.save(tmp, state)
             self._best_ckptr.wait_until_finished()
             if self._best_dir.exists():
-                shutil.rmtree(self._best_dir)
+                self._best_dir.rename(old)
             tmp.rename(self._best_dir)
+            if old.exists():
+                shutil.rmtree(old)
+
+    def _recover_best(self) -> None:
+        """Finish an interrupted best-swap, newest copy first.
+
+        Orbax finalizes a save by atomically renaming its own staging dir
+        into the target, so an existing ``best_tmp`` is always a fully
+        committed (and newer) checkpoint — prefer it over ``best_old``;
+        a half-written save only ever leaves ``best_tmp.orbax-*`` litter,
+        which the stale cleanup in save() removes."""
+        if self._best_dir.exists():
+            return
+        tmp = self.directory / "best_tmp"
+        old = self.directory / "best_old"
+        if tmp.exists():
+            tmp.rename(self._best_dir)
+        elif old.exists():
+            old.rename(self._best_dir)
 
     def flush(self) -> None:
         """Block until all in-flight checkpoint writes are committed."""
@@ -94,6 +119,7 @@ class TrainCheckpointer:
 
     def restore_best(self, template: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         self.flush()
+        self._recover_best()
         if not self._best_dir.exists():
             return None
         return self._best_ckptr.restore(self._best_dir, template)

@@ -1,0 +1,84 @@
+"""TrainCheckpointer best-swap durability.
+
+The reference keeps a 'best' weights dir updated whenever validation
+improves (custom_trainer.py:746-754).  Ours swaps it via rename-aside so
+a crash at any instant leaves a committed best under ``best`` or
+``best_old``; these tests pin the happy path and the crash-window
+recovery.
+"""
+
+import numpy as np
+
+from memvul_tpu.training.checkpoint import TrainCheckpointer
+
+
+def _state(v: float):
+    return {"w": np.full((4,), v, dtype=np.float32)}
+
+
+def test_best_swap_roundtrip(tmp_path):
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck.save(0, _state(1.0), is_best=True)
+    ck.save(1, _state(2.0), is_best=True)
+    restored = ck.restore_best(_state(0.0))
+    ck.close()
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 2.0))
+    # no stale aside/tmp dirs left behind
+    assert not (tmp_path / "ck" / "best_old").exists()
+    assert not (tmp_path / "ck" / "best_tmp").exists()
+
+
+def test_best_swap_crash_window_recovers_from_aside(tmp_path):
+    """Simulate a crash between 'move old best aside' and 'rename new into
+    place': only ``best_old`` exists.  restore_best must recover it."""
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck.save(0, _state(3.0), is_best=True)
+    ck.flush()
+    best = tmp_path / "ck" / "best"
+    best.rename(tmp_path / "ck" / "best_old")  # the crash left this state
+    restored = ck.restore_best(_state(0.0))
+    ck.close()
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 3.0))
+    assert best.exists()  # recovered into place
+
+
+def test_best_swap_crash_window_prefers_committed_tmp(tmp_path):
+    """Crash after ``best_old`` was moved aside AND the replacement
+    committed under ``best_tmp`` (but before its rename): recovery must
+    promote the NEWER best_tmp, not roll back to best_old — epoch
+    metadata already records the newer epoch as best."""
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck.save(0, _state(1.0), is_best=True)
+    ck.flush()
+    base = tmp_path / "ck"
+    (base / "best").rename(base / "best_old")  # older best, moved aside
+    ck._best_ckptr.save(base / "best_tmp", _state(9.0))  # newer, committed
+    ck._best_ckptr.wait_until_finished()
+    restored = ck.restore_best(_state(0.0))
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 9.0))
+    # a later save cleans up the leftover aside copy
+    ck.save(1, _state(10.0), is_best=True)
+    ck.flush()
+    assert not (base / "best_old").exists()
+    ck.close()
+
+
+def test_first_best_save_crash_leaves_only_tmp(tmp_path):
+    """Crash after the very first best save committed ``best_tmp`` but
+    before any rename: restore_best must still find it."""
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck._best_ckptr.save(tmp_path / "ck" / "best_tmp", _state(5.0))
+    ck._best_ckptr.wait_until_finished()
+    restored = ck.restore_best(_state(0.0))
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 5.0))
+    ck.close()
+
+
+def test_restore_best_none_when_never_saved(tmp_path):
+    ck = TrainCheckpointer(tmp_path / "ck")
+    assert ck.restore_best(_state(0.0)) is None
+    ck.close()
