@@ -4,7 +4,9 @@ The reference drives everything from JSON/Jsonnet configs and, at test
 time, deep-merges a partial override config onto the archived train config
 (reference: predict_memory.py:60-67, test_config_memory.json).  This module
 reproduces that contract: ``load_config`` reads a JSON file (tolerating
-``//`` comments, which the reference's Jsonnet configs use), and
+``//`` comments and the Jsonnet subset the reference configs actually use
+— top-level ``local name = <literal>;`` bindings referenced by bare
+identifier in value position, e.g. config_memory.json:1-3), and
 ``merge_overrides`` deep-merges dicts, with dotted keys reaching into
 nested objects.
 """
@@ -17,13 +19,57 @@ import re
 from pathlib import Path
 from typing import Any, Dict, Optional, Union
 
+def _split_strings(text: str) -> list:
+    """Split into alternating ``(is_string, chunk)`` segments — the
+    string-aware scanner the locals/body passes below share.  String
+    chunks include their quotes and honor backslash escapes; an
+    unterminated string runs to end-of-text (json.loads reports it).
+    Only valid on COMMENT-STRIPPED text: a quote inside a ``//`` comment
+    would otherwise open a phantom string (config_memory_large_tp.json's
+    header comment quotes axis names).
+    """
+    segments = []
+    i, n = 0, len(text)
+    while i < n:
+        if text[i] == '"':
+            j = i + 1
+            while j < n:
+                if text[j] == "\\":
+                    j += 2
+                elif text[j] == '"':
+                    j += 1
+                    break
+                else:
+                    j += 1
+            else:
+                j = n
+            segments.append((True, text[i:j]))
+            i = j
+        else:
+            j = text.find('"', i)
+            if j == -1:
+                j = n
+            segments.append((False, text[i:j]))
+            i = j
+    return segments
+
+
+_LOCAL_RE = re.compile(r"\s*local\s+([A-Za-z_]\w*)\s*=")
+_IDENT_RE = re.compile(r"[A-Za-z_]\w*")
+_TRAILING_COMMA_RE = re.compile(r",(?=\s*[}\]])")
+_JSON_WORDS = frozenset({"true", "false", "null"})
+
+
 def _strip_comments(text: str) -> str:
     """Drop ``//`` line comments that are outside JSON strings.
 
     The reference's configs carry trailing comments, e.g.
     ``"max_length": 512  // different from the data reader``
     (reference: MemVul/config_no_online.json:89), and ``//`` also appears
-    inside string values (URLs), so a string-aware scan is required.
+    inside string values (URLs), so the scan must be string-aware.  This
+    one pass cannot reuse ``_split_strings``: comments and strings each
+    hide the other's delimiter, so quote- and comment-state must advance
+    together; every later pass runs on comment-free text and can.
     """
     out = []
     i, n = 0, len(text)
@@ -51,8 +97,68 @@ def _strip_comments(text: str) -> str:
     return "".join(out)
 
 
+def _parse_locals(text: str) -> tuple:
+    """Consume leading ``local name = <value>;`` bindings.
+
+    Returns ``(bindings, body)``.  Values are JSON literals (the only
+    forms the reference's configs use: strings and numbers,
+    config_memory.json:1-3) or references to earlier locals.  The
+    terminating ``;`` is found outside strings so string values
+    containing semicolons parse correctly.
+    """
+    bindings: Dict[str, Any] = {}
+    pos = 0
+    while True:
+        m = _LOCAL_RE.match(text, pos)
+        if not m:
+            break
+        end = m.end()
+        for is_str, chunk in _split_strings(text[end:]):
+            if not is_str and ";" in chunk:
+                end += chunk.index(";")
+                break
+            end += len(chunk)
+        else:
+            raise ValueError(f"unterminated 'local {m.group(1)} = ...' binding")
+        raw = text[m.end() : end].strip()
+        if _IDENT_RE.fullmatch(raw) and raw in bindings:
+            bindings[m.group(1)] = bindings[raw]
+        else:
+            bindings[m.group(1)] = json.loads(raw)
+        pos = end + 1
+    return bindings, text[pos:]
+
+
+def _jsonnetise_body(body: str, bindings: Dict[str, Any]) -> str:
+    """Make the Jsonnet body valid JSON: substitute bare identifiers with
+    their bound JSON value and drop trailing commas (both Jsonnet-legal,
+    both used by the reference configs — config_memory.json:6,69).
+
+    Body keys are always quoted in the reference configs, so any bare
+    identifier outside a string is a reference.  Unbound identifiers are
+    left for json.loads to reject with its own error position.  A comma
+    is trailing only when whitespace separates it from the closing
+    bracket, so the per-chunk regex never crosses a string boundary.
+    """
+
+    def substitute(m: "re.Match") -> str:
+        word = m.group(0)
+        if word in bindings and word not in _JSON_WORDS:
+            return json.dumps(bindings[word])
+        return word
+
+    return "".join(
+        chunk
+        if is_str
+        else _TRAILING_COMMA_RE.sub("", _IDENT_RE.sub(substitute, chunk))
+        for is_str, chunk in _split_strings(body)
+    )
+
+
 def loads_config(text: str) -> Dict[str, Any]:
-    return json.loads(_strip_comments(text))
+    stripped = _strip_comments(text)
+    bindings, body = _parse_locals(stripped)
+    return json.loads(_jsonnetise_body(body, bindings))
 
 
 def load_config(

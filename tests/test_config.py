@@ -173,3 +173,67 @@ def test_shipped_large_tp_config_builds_and_splits():
     params = jax.eval_shape(model.init, jax.random.PRNGKey(0), dummy, dummy)
     mesh = create_mesh({"data": 1, "model": 8})
     assert not validate_divisibility(params, mesh)
+
+
+# -- Jsonnet `local` subset (reference config_memory.json:1-3) ---------------
+
+
+def test_jsonnet_locals_substitute_in_value_position():
+    cfg = loads_config(
+        'local bert_model = "bert-base-uncased";\n'
+        "local seed = 2021;\n"
+        '{"random_seed": seed, "model_name": bert_model,\n'
+        ' "nested": {"PTM": bert_model}, "flag": true}'
+    )
+    assert cfg["random_seed"] == 2021
+    assert cfg["model_name"] == "bert-base-uncased"
+    assert cfg["nested"]["PTM"] == "bert-base-uncased"
+    assert cfg["flag"] is True
+
+
+def test_jsonnet_local_chained_reference():
+    cfg = loads_config('local a = "x";\nlocal b = a;\n{"k": b}')
+    assert cfg == {"k": "x"}
+
+
+def test_jsonnet_local_string_with_semicolon_and_comment():
+    cfg = loads_config(
+        'local p = "a;b";  // comment after binding\n{"path": p}'
+    )
+    assert cfg == {"path": "a;b"}
+
+
+def test_jsonnet_identifier_not_substituted_inside_strings():
+    cfg = loads_config('local seed = 7;\n{"note": "seed stays literal", "s": seed}')
+    assert cfg == {"note": "seed stays literal", "s": 7}
+
+
+def test_reference_config_files_parse_verbatim():
+    """The reference's own Jsonnet configs load without modification
+    (the last ergonomic gap in the drop-in config shape)."""
+    import pathlib
+
+    import pytest
+
+    ref = pathlib.Path("/root/reference/MemVul")
+    if not ref.exists():
+        pytest.skip("reference checkout not present")
+    for name in (
+        "config_memory.json",
+        "config_no_online.json",
+        "config_no_pretrain.json",
+        "config_single.json",
+    ):
+        cfg = loads_config((ref / name).read_text())
+        assert cfg["random_seed"] == 2021
+        assert "dataset_reader" in cfg and "trainer" in cfg
+
+
+def test_jsonnet_trailing_commas_dropped_outside_strings():
+    cfg = loads_config('{"a": [1, 2,], "b": {"c": 3,}, "s": "x,]"}')
+    assert cfg == {"a": [1, 2], "b": {"c": 3}, "s": "x,]"}
+
+
+def test_comment_containing_quotes_does_not_open_string():
+    cfg = loads_config('{\n// shards on "model", batches on "data"\n"a": 1, // "x"\n"b": 2}')
+    assert cfg == {"a": 1, "b": 2}
