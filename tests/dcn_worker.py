@@ -1,0 +1,81 @@
+"""Worker for the two-process DCN smoke test (run via subprocess).
+
+Each process joins the jax.distributed runtime through
+``memvul_tpu.parallel.multihost.initialize`` — the TPU-native equivalent
+of the reference's torch.distributed/NCCL backend init
+(custom_trainer.py:254-259) — then proves the cross-process contract:
+
+- process_count / is_primary reflect the 2-process launch
+- ``local_batch_slice`` tiles the global batch across hosts
+- a data-sharded global array reduces across processes (XLA inserts the
+  DCN collective; on CPU it rides Gloo, on pods it rides DCN)
+
+Writes one JSON line to the path in argv[3]; the pytest side asserts it.
+
+Usage: python dcn_worker.py <process_id> <coordinator_port> <out_path>
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from memvul_tpu.utils.platform import honor_platform_env  # noqa: E402
+
+honor_platform_env()
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    port = int(sys.argv[2])
+    out_path = sys.argv[3]
+
+    from memvul_tpu.parallel import multihost
+
+    joined = multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from memvul_tpu.parallel.multihost import local_batch_slice
+
+    sl = local_batch_slice(8)
+
+    # each process contributes only ITS slice of the global batch (the
+    # host-side input pipeline contract), then one jit reduces globally
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("data",))
+    global_batch = np.arange(8, dtype=np.float32)
+    local = global_batch[sl]
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local, global_shape=(8,)
+    )
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(arr)
+
+    result = {
+        "joined": bool(joined),
+        "process_id": process_id,
+        "process_count": multihost.process_count(),
+        "is_primary": multihost.is_primary(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "slice": [sl.start, sl.stop],
+        "global_sum": float(total),
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Two-process DCN smoke: the multi-host path with real process
+boundaries.
+
+The single-process tests (test_ema_multihost.py) cover the helpers'
+logic; this one actually launches TWO processes that join one
+jax.distributed runtime and reduce across the process boundary — the
+contract the reference's NCCL backend provides (custom_trainer.py:
+254-259, 379-396), here carried by the jax coordination service + XLA
+collectives (Gloo on CPU, DCN on pods).
+"""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_WORKER = Path(__file__).parent / "dcn_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_reduction(tmp_path):
+    port = _free_port()
+    outs = [tmp_path / f"proc{i}.json" for i in range(2)]
+    # worker output goes to files, not pipes: a worker blocked on a full
+    # pipe buffer would stall the OTHER worker at the distributed barrier
+    logs = [open(tmp_path / f"proc{i}.log", "wb") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(_WORKER), str(i), str(port), str(outs[i])],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+        for i, log in enumerate(logs)
+    ]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                p.wait(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("DCN worker timed out")
+            assert p.returncode == 0, (
+                tmp_path / f"proc{i}.log"
+            ).read_text()[-2000:]
+    finally:
+        for log in logs:
+            log.close()
+
+    results = [json.loads(o.read_text()) for o in outs]
+    for i, r in enumerate(results):
+        assert r["joined"] is True
+        assert r["process_count"] == 2
+        assert r["is_primary"] is (i == 0)
+        assert r["local_devices"] == 2
+        assert r["global_devices"] == 4
+        # both processes agree on the cross-process reduction: sum(0..7)
+        assert r["global_sum"] == 28.0
+    # the two local_batch_slice results tile the global batch exactly
+    assert results[0]["slice"] == [0, 4]
+    assert results[1]["slice"] == [4, 8]
