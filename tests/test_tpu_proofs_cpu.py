@@ -1,0 +1,122 @@
+"""CPU validation of the round-4 proof runners.
+
+The on-chip proofs (tools/tpu_proofs.py) gate on real TPU hardware; these
+tests drive the SAME code paths at tiny geometry on CPU so a harness bug
+never survives to the (scarce, serialized) chip window — the round-3
+lesson, when two proof kinds shipped untested and the chip wedged.
+"""
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, "tools")
+import tpu_proofs  # noqa: E402
+
+
+def test_train_case_tiny_runs_all_ab_variants():
+    """Every A/B lever (remat, microbatch, flash attention) builds and
+    steps at tiny geometry — the exact code run_trainab uses on chip."""
+    for kw in (
+        dict(),
+        dict(remat=False),
+        dict(K=1, B=4),
+        dict(attention_impl="flash"),
+    ):
+        case = tpu_proofs._train_case(
+            K=kw.get("K", 2), B=kw.get("B", 2), L=32, n_steps=2,
+            preset="tiny",
+            remat=kw.get("remat", True),
+            attention_impl=kw.get("attention_impl", "xla"),
+        )
+        assert case["steady_step_mean_s"] > 0
+        assert case["pairs_per_s"] > 0
+        g = case["geometry"]
+        assert g["model"] == "bert-tiny"
+        assert g["attention_impl"] == kw.get("attention_impl", "xla")
+
+
+def test_bf16drift_tiny_cpu(tmp_path, monkeypatch):
+    monkeypatch.setattr(tpu_proofs, "RESULTS", tmp_path / "proofs.json")
+    payload = tpu_proofs.run_bf16drift(
+        A=5, N=16, B=8, L=32, preset="tiny", require_tpu=False
+    )
+    assert payload["n_reports"] == 16
+    assert 0.0 <= payload["max_abs_dp"] < 0.2
+    assert 0.0 <= payload["flip_rate"] <= 1.0
+    assert 0.0 <= payload["argmax_anchor_agreement"] <= 1.0
+    # record landed on disk as one JSON line
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "proofs.json").read_text().splitlines()
+    ]
+    assert rows[-1]["kind"] == "bf16_score_drift"
+
+
+def test_smoke_md_renders_new_kinds(tmp_path):
+    records = [
+        {
+            "kind": "train_ab_base_geometry",
+            "backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "rows": [
+                {
+                    "variant": "base_remat_K2x32",
+                    "geometry": {},
+                    "steady_step_mean_s": 0.477,
+                    "pairs_per_s": 134.2,
+                    "first_step_s_incl_compile": 30.0,
+                },
+                {"variant": "noremat_K2x32", "error": "RESOURCE_EXHAUSTED: oom"},
+            ],
+        },
+        {
+            "kind": "bf16_score_drift",
+            "backend": "tpu",
+            "device_kind": "TPU v5 lite",
+            "model": "bert-base",
+            "n_reports": 4096,
+            "n_anchors": 129,
+            "seq_len": 256,
+            "max_abs_dp": 0.012,
+            "p99_abs_dp": 0.008,
+            "mean_abs_dp": 0.001,
+            "flips_at_0.5": 3,
+            "flip_rate": 3 / 4096,
+            "argmax_anchor_agreement": 0.999,
+            "note": "random-init caveat",
+        },
+    ]
+    src = tmp_path / "proofs.json"
+    src.write_text("\n".join(json.dumps(r) for r in records))
+    out = tmp_path / "SMOKE.md"
+    tpu_proofs.write_smoke_md(src, out)
+    text = out.read_text()
+    assert "Train-step A/B" in text and "477 ms" in text
+    assert "failed: RESOURCE_EXHAUSTED" in text
+    assert "bf16 vs f32 best-anchor score drift" in text
+    assert "3/4096" in text
+
+
+def test_main_rejects_unknown_and_accepts_multi(monkeypatch):
+    assert tpu_proofs.main(["nope"]) == 2
+    ran = []
+    for name in list(tpu_proofs._RUNNERS):
+        monkeypatch.setitem(
+            tpu_proofs._RUNNERS, name, lambda n=name: ran.append(n)
+        )
+    monkeypatch.setattr(tpu_proofs, "write_smoke_md", lambda: None)
+    assert tpu_proofs.main(["flashgrad", "mlmsmoke"]) == 0
+    assert ran == ["flashgrad", "mlmsmoke"]
+    ran.clear()
+    assert tpu_proofs.main([]) == 0
+    assert ran == list(tpu_proofs._RUNNERS)
+
+
+def test_hbm_fields_absent_stats_are_none():
+    f = tpu_proofs._hbm_fields({})
+    assert f == {"peak_hbm_gb": None, "hbm_limit_gb": None}
+    f = tpu_proofs._hbm_fields({"peak_bytes_in_use": 2e9, "bytes_limit": 16e9})
+    assert f["peak_hbm_gb"] == pytest.approx(2.0)
+    assert f["hbm_limit_gb"] == pytest.approx(16.0)

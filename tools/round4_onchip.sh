@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-4 on-chip agenda, strictly serialized (one JAX client at a time —
+# the axon tunnel wedges under concurrent clients; see SMOKE.md header).
+#
+# Runs, in order of round-3 verdict priority:
+#   1. bench.py at the shipped default config     -> the driver-comparable number
+#   2. bucket/inflight sweep (verdict #2)         -> pick the shipped default
+#   3. flash-vs-xla bench A/B (verdict #3)
+#   4. streaming rehearsal 16k vs 100k (verdict #6)
+#   5. tpu_proofs: flash(256..4096) flashgrad mlmsmoke trainsmoke trainab bf16drift
+#
+# Usage: bash tools/round4_onchip.sh [logdir]   (default round4_logs/)
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-round4_logs}
+mkdir -p "$LOG"
+
+probe() {
+  timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128)); print('alive', float((x@x).sum()))" >/dev/null 2>&1
+}
+
+step() { # step <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  if ! probe; then
+    echo "TUNNEL DEAD before $name — aborting remaining steps" | tee "$LOG/ABORTED"
+    exit 3
+  fi
+  ( "$@" ) > "$LOG/$name.out" 2> "$LOG/$name.err" &
+  local pid=$!
+  if ! timeout "$tmo" tail --pid=$pid -f /dev/null; then
+    echo "$name TIMED OUT after ${tmo}s — killing" | tee -a "$LOG/$name.err"
+    kill -9 $pid 2>/dev/null
+    sleep 5
+  fi
+  wait $pid 2>/dev/null
+  echo "rc=$? -> $LOG/$name.out"
+  tail -1 "$LOG/$name.out"
+}
+
+# 1. the headline number, default config (matches what the driver runs)
+step bench_default 2400 env BENCH_DEVICE_WAIT=60 python bench.py
+
+# 2. bucket sweep (fewer reports to keep sweep cheap; relative rps decides)
+step bench_auto6   1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=auto BENCH_BUCKET_COUNT=6 BENCH_REPORTS=16384 python bench.py
+step bench_auto8   1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=auto BENCH_BUCKET_COUNT=8 BENCH_REPORTS=16384 python bench.py
+step bench_hand16k 1800 env BENCH_DEVICE_WAIT=60 BENCH_REPORTS=16384 python bench.py
+step bench_inflight4 1800 env BENCH_DEVICE_WAIT=60 BENCH_INFLIGHT=4 BENCH_REPORTS=16384 python bench.py
+
+# 3. flash-vs-xla at workload lengths (bench-level A/B; kernel-level in proofs)
+step bench_flash   1800 env BENCH_DEVICE_WAIT=60 BENCH_ATTENTION=flash BENCH_REPORTS=16384 python bench.py
+
+# 4. streaming rehearsal: does 100k sustain 16k's rate? (bench_hand16k above
+#    is the 16k side; this is the 100k side, same config)
+step bench_100k    4800 env BENCH_DEVICE_WAIT=60 BENCH_REPORTS=102400 python bench.py
+
+# 5. hardware proofs (flash now covers 256/512; trainab = MFU levers;
+#    bf16drift = score-drift bound)
+step proofs_flash     2400 python tools/tpu_proofs.py flash
+step proofs_flashgrad 2400 python tools/tpu_proofs.py flashgrad
+step proofs_mlmsmoke  1800 python tools/tpu_proofs.py mlmsmoke
+step proofs_trainsmoke 1800 python tools/tpu_proofs.py trainsmoke
+step proofs_trainab   3600 python tools/tpu_proofs.py trainab
+step proofs_bf16drift 1800 python tools/tpu_proofs.py bf16drift
+
+echo "=== all steps done ($(date +%H:%M:%S)) — results in $LOG/ ==="
