@@ -1,4 +1,4 @@
-from .bert import BertConfig, BertEncoder, BertPooler  # noqa: F401
+from .bert import BertConfig, BertEncoder, BertPooler, ScalarMix  # noqa: F401
 from .memory import (  # noqa: F401
     MemoryModel,
     anchor_probs,

@@ -43,6 +43,10 @@ class BertConfig:
     attention_impl: str = "xla"
     remat: bool = False
     scan_layers: bool = False
+    # False = ScalarMix over all layer outputs instead of the last layer
+    # (reference: custom_PTM_embedder.py:107-118; unused by every shipped
+    # reference config, provided for drop-in parity)
+    last_layer_only: bool = True
 
     @classmethod
     def tiny(cls, vocab_size: int = 2048, **kw) -> "BertConfig":
@@ -171,23 +175,31 @@ class BertLayer(nn.Module):
 
 
 class _ScanBody(nn.Module):
-    """BertLayer adapted to the (carry, y) contract nn.scan expects."""
+    """BertLayer adapted to the (carry, y) contract nn.scan expects.
+    ``collect`` additionally emits each layer's output as the scan ys
+    (stacked [L, B, T, H] by nn.scan) for the ScalarMix path."""
 
     config: BertConfig
     deterministic: bool
+    collect: bool = False
 
     @nn.compact
     def __call__(self, hidden, bias):
         out = BertLayer(self.config, name="layer")(hidden, bias, self.deterministic)
-        return out, None
+        return out, (out if self.collect else None)
 
 
 class BertEncoderStack(nn.Module):
+    """Returns the last layer's hidden states, or the stacked per-layer
+    outputs [L, B, T, H] when ``config.last_layer_only`` is False (the
+    ScalarMix path)."""
+
     config: BertConfig
 
     @nn.compact
     def __call__(self, hidden, bias, deterministic: bool):
         c = self.config
+        collect = not c.last_layer_only
         if c.scan_layers:
             # one compiled layer body scanned over the depth axis: flat
             # compile time, stacked params [L, ...]
@@ -198,13 +210,37 @@ class BertEncoderStack(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=c.num_layers,
                 in_axes=(nn.broadcast,),
-            )(c, deterministic, name="layers")
-            hidden, _ = scanned(hidden, bias)
-            return hidden
+            )(c, deterministic, collect, name="layers")
+            hidden, stacked = scanned(hidden, bias)
+            return stacked if collect else hidden
         layer_cls = nn.remat(BertLayer, static_argnums=(3,)) if c.remat else BertLayer
+        outputs = []
         for i in range(c.num_layers):
             hidden = layer_cls(c, name=f"layer_{i}")(hidden, bias, deterministic)
-        return hidden
+            if collect:
+                outputs.append(hidden)
+        return jnp.stack(outputs) if collect else hidden
+
+
+class ScalarMix(nn.Module):
+    """Learned softmax-weighted combination of all layer outputs, scaled
+    by a learned gamma — the option the reference's PTM embedder enables
+    when ``last_layer_only=False`` (reference: custom_PTM_embedder.py:
+    107-118, wiring AllenNLP's ScalarMix).  Weights mix in f32; the
+    result returns to the compute dtype."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, stacked):  # [L, B, T, H] -> [B, T, H]
+        num_layers = stacked.shape[0]
+        weights = self.param("scalar_weights", nn.initializers.zeros, (num_layers,))
+        gamma = self.param("gamma", nn.initializers.ones, ())
+        norm = jax.nn.softmax(weights.astype(jnp.float32))
+        mixed = jnp.einsum(
+            "l,l...->...", norm.astype(stacked.dtype), stacked
+        )
+        return gamma.astype(stacked.dtype) * mixed
 
 
 class BertEncoder(nn.Module):
@@ -234,7 +270,10 @@ class BertEncoder(nn.Module):
             input_ids, token_type_ids, deterministic, position_ids=position_ids
         )
         bias = mask_to_bias(attention_mask, dtype=c.dtype)
-        return BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)
+        out = BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)
+        if c.last_layer_only:
+            return out
+        return ScalarMix(c, name="scalar_mix")(out)
 
 
 class BertPooler(nn.Module):
