@@ -1,9 +1,16 @@
-"""Optimizers — parameter-group AdamW + linear warmup, in optax.
+"""Optimizers — parameter-group AdamW + schedule family, in optax.
 
 The reference uses HF AdamW with parameter groups (embedder lr 2e-5,
 pooler lr 5e-5, everything else lr 1e-4) and a linear-with-warmup
 schedule (warmup 10000) plus grad-norm clipping
 (reference: MemVul/config_memory.json:60-75, custom_trainer.py:263-277).
+Its trainer also accepts any AllenNLP LearningRateScheduler /
+MomentumScheduler (custom_trainer.py:168-169, stepped at 741-744);
+:func:`make_schedule` provides the non-linear members of that family as
+pure step→scale functions (jit-friendly, no host-side stepping), and a
+momentum schedule drives AdamW's b1 through
+``optax.inject_hyperparams`` — no shipped reference config uses either,
+they exist for drop-in parity.
 
 Here parameter groups are expressed as path-prefix rules mapped through
 ``optax.multi_transform``; the warmup/decay schedule is a shared scale so
@@ -41,6 +48,116 @@ def linear_with_warmup(
     return schedule
 
 
+def make_schedule(spec: Dict) -> optax.Schedule:
+    """``{"type": ..., ...}`` → a step→scale schedule in [0, 1].
+
+    Types (mirroring the AllenNLP scheduler family the reference trainer
+    accepts; all step-based and traceable):
+
+    * ``constant`` — 1.0
+    * ``linear_with_warmup`` — warmup_steps, total_steps (optional decay)
+    * ``slanted_triangular`` — num_steps, cut_frac=0.1, ratio=32
+      (Howard & Ruder's STLR: short linear climb, long linear fall,
+      floor at 1/ratio)
+    * ``cosine_with_warmup`` — warmup_steps, total_steps: half-cosine
+      from 1 to 0 after warmup
+    * ``polynomial_decay`` — warmup_steps, total_steps, power=1.0,
+      end_factor=0.0
+    """
+    import jax.numpy as jnp
+
+    kind = spec.get("type", "linear_with_warmup")
+    warmup = float(spec.get("warmup_steps", 0))
+    total = spec.get("total_steps", spec.get("num_steps"))
+
+    if kind == "constant":
+        return lambda step: jnp.float32(1.0)
+
+    if kind == "linear_with_warmup":
+        return linear_with_warmup(int(warmup), total)
+
+    if kind == "slanted_triangular":
+        if total is None:
+            raise ValueError("slanted_triangular needs num_steps/total_steps")
+        cut_frac = float(spec.get("cut_frac", 0.1))
+        ratio = float(spec.get("ratio", 32))
+        cut = max(1.0, float(total) * cut_frac)
+
+        def stlr(step):
+            t = jnp.asarray(step, jnp.float32)
+            frac_up = t / cut
+            frac_down = 1.0 - (t - cut) / jnp.maximum(1.0, float(total) - cut)
+            p = jnp.clip(jnp.where(t < cut, frac_up, frac_down), 0.0, 1.0)
+            return (1.0 + p * (ratio - 1.0)) / ratio
+
+        return stlr
+
+    if kind == "cosine_with_warmup":
+        if total is None:
+            raise ValueError("cosine_with_warmup needs total_steps")
+
+        def cosine(step):
+            t = jnp.asarray(step, jnp.float32)
+            warm = t / jnp.maximum(1.0, warmup)
+            progress = jnp.clip(
+                (t - warmup) / jnp.maximum(1.0, float(total) - warmup), 0.0, 1.0
+            )
+            after = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+            return jnp.where(t < warmup, warm, after)
+
+        return cosine
+
+    if kind == "polynomial_decay":
+        if total is None:
+            raise ValueError("polynomial_decay needs total_steps")
+        power = float(spec.get("power", 1.0))
+        end = float(spec.get("end_factor", 0.0))
+
+        def poly(step):
+            t = jnp.asarray(step, jnp.float32)
+            warm = t / jnp.maximum(1.0, warmup)
+            progress = jnp.clip(
+                (t - warmup) / jnp.maximum(1.0, float(total) - warmup), 0.0, 1.0
+            )
+            after = (1.0 - progress) ** power * (1.0 - end) + end
+            return jnp.where(t < warmup, warm, after)
+
+        return poly
+
+    raise ValueError(f"unknown schedule type {kind!r}")
+
+
+def make_momentum_schedule(spec: Dict, base: float = 0.9) -> optax.Schedule:
+    """Momentum (AdamW b1) schedule — the reference trainer's
+    MomentumScheduler slot (custom_trainer.py:169,743-744).
+
+    ``inverted_triangular`` (the one concrete AllenNLP momentum
+    scheduler): ramp from ``base`` down to ``low`` over ``cooldown``
+    steps, back up to ``base`` over ``warmup`` steps, then hold.
+    ``constant`` holds ``base``.
+    """
+    import jax.numpy as jnp
+
+    kind = spec.get("type", "inverted_triangular")
+    if kind == "constant":
+        return lambda step: jnp.float32(base)
+    if kind != "inverted_triangular":
+        raise ValueError(f"unknown momentum schedule type {kind!r}")
+    low = float(spec.get("low", 0.85))
+    cooldown = float(spec.get("cooldown_steps", spec.get("cooldown", 1)))
+    warmup = float(spec.get("warmup_steps", spec.get("warmup", 1)))
+
+    def schedule(step):
+        t = jnp.asarray(step, jnp.float32)
+        down = base + (low - base) * t / jnp.maximum(1.0, cooldown)
+        up = low + (base - low) * (t - cooldown) / jnp.maximum(1.0, warmup)
+        return jnp.where(
+            t < cooldown, down, jnp.where(t < cooldown + warmup, up, base)
+        )
+
+    return schedule
+
+
 def label_params_by_prefix(
     params, rules: Sequence[Tuple[str, str]], default: str = "default"
 ):
@@ -70,25 +187,43 @@ def make_optimizer(
     betas: Tuple[float, float] = (0.9, 0.999),
     weight_decay: float = 0.0,
     grad_clip_norm: Optional[float] = 1.0,
+    lr_schedule: Optional[Dict] = None,
+    momentum_schedule: Optional[Dict] = None,
 ) -> Tuple[optax.GradientTransformation, object]:
     """Build the reference's optimizer stack.
 
     Default groups mirror config_memory.json:60-68: the BERT encoder at
-    2e-5, the pooler at 5e-5, heads at ``base_lr``.
-    Returns (optimizer, opt_state).
+    2e-5, the pooler at 5e-5, heads at ``base_lr``.  ``lr_schedule``
+    (a :func:`make_schedule` spec) replaces the default linear-warmup
+    scale; ``momentum_schedule`` (a :func:`make_momentum_schedule` spec)
+    drives AdamW's b1 per step.  Returns (optimizer, opt_state).
     """
     if group_rules is None:
         group_rules = (("bert/", "embedder"), ("pooler/", "pooler"))
     if group_lrs is None:
         group_lrs = {"embedder": 2e-5, "pooler": 5e-5}
-    schedule = (
-        linear_with_warmup(warmup_steps, total_steps)
-        if (warmup_steps or total_steps is not None)
-        else None
-    )
+    if lr_schedule is not None:
+        spec = dict(lr_schedule)
+        spec.setdefault("warmup_steps", warmup_steps)
+        spec.setdefault("total_steps", total_steps)
+        schedule = make_schedule(spec)
+    else:
+        schedule = (
+            linear_with_warmup(warmup_steps, total_steps)
+            if (warmup_steps or total_steps is not None)
+            else None
+        )
+
+    def scale_by_adam_tx() -> optax.GradientTransformation:
+        if momentum_schedule is not None:
+            b1 = make_momentum_schedule(momentum_schedule, base=betas[0])
+            return optax.inject_hyperparams(optax.scale_by_adam)(
+                b1=b1, b2=betas[1]
+            )
+        return optax.scale_by_adam(b1=betas[0], b2=betas[1])
 
     def adamw(lr: float) -> optax.GradientTransformation:
-        chain = [optax.scale_by_adam(b1=betas[0], b2=betas[1])]
+        chain = [scale_by_adam_tx()]
         if weight_decay:
             chain.append(optax.add_decayed_weights(weight_decay))
         if schedule is not None:

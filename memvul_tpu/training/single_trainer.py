@@ -88,6 +88,10 @@ class ClassifierTrainerConfig:
     total_steps: Optional[int] = None
     base_lr: float = 2e-5
     group_lrs: Optional[Dict[str, float]] = None
+    # optim.make_schedule / make_momentum_schedule specs (the reference
+    # trainer's scheduler slots); None = linear warmup / constant b1
+    learning_rate_scheduler: Optional[Dict] = None
+    momentum_scheduler: Optional[Dict] = None
     grad_clip_norm: Optional[float] = 1.0
     weight_decay: float = 0.0
     seed: int = 2021
@@ -134,6 +138,8 @@ class ClassifierTrainer:
             total_steps=c.total_steps,
             grad_clip_norm=c.grad_clip_norm,
             weight_decay=c.weight_decay,
+            lr_schedule=c.learning_rate_scheduler,
+            momentum_schedule=c.momentum_scheduler,
         )
         if mesh is not None:
             params = replicate(params, mesh)

@@ -169,6 +169,12 @@ class TrainerConfig:
     total_steps: Optional[int] = None  # enables linear decay after warmup
     base_lr: float = 1e-4
     group_lrs: Optional[Dict[str, float]] = None
+    # non-linear LR / momentum schedules — the reference trainer's
+    # scheduler slots (custom_trainer.py:168-169,741-744); specs for
+    # optim.make_schedule / make_momentum_schedule.  None = the default
+    # linear warmup(+decay) above / constant b1
+    learning_rate_scheduler: Optional[Dict] = None
+    momentum_scheduler: Optional[Dict] = None
     grad_clip_norm: Optional[float] = 1.0
     weight_decay: float = 0.0
     seed: int = 2021
@@ -234,6 +240,8 @@ class MemoryTrainer:
             total_steps=total_steps,
             grad_clip_norm=c.grad_clip_norm,
             weight_decay=c.weight_decay,
+            lr_schedule=c.learning_rate_scheduler,
+            momentum_schedule=c.momentum_scheduler,
         )
         if mesh is not None:
             params = replicate(params, mesh)

@@ -1,0 +1,158 @@
+"""LR-schedule family + momentum scheduler (reference trainer's
+scheduler slots, custom_trainer.py:168-169, stepped at 741-744 — no
+shipped reference config uses them; provided for drop-in parity)."""
+
+import numpy as np
+import pytest
+
+from memvul_tpu.training.optim import (
+    make_momentum_schedule,
+    make_optimizer,
+    make_schedule,
+)
+
+
+def _eval(schedule, steps):
+    return np.asarray([float(schedule(s)) for s in steps])
+
+
+def test_constant():
+    s = make_schedule({"type": "constant"})
+    np.testing.assert_allclose(_eval(s, [0, 10, 1000]), 1.0)
+
+
+def test_linear_with_warmup_spec():
+    s = make_schedule(
+        {"type": "linear_with_warmup", "warmup_steps": 10, "total_steps": 110}
+    )
+    vals = _eval(s, [0, 5, 10, 60, 110])
+    np.testing.assert_allclose(vals, [0.0, 0.5, 1.0, 0.5, 0.0], atol=1e-6)
+
+
+def test_slanted_triangular_shape():
+    s = make_schedule(
+        {"type": "slanted_triangular", "num_steps": 100, "cut_frac": 0.1,
+         "ratio": 32}
+    )
+    vals = _eval(s, [0, 5, 10, 55, 100])
+    # climbs to 1.0 at the cut, falls back to the 1/ratio floor
+    assert vals[0] == pytest.approx(1 / 32)
+    assert vals[1] == pytest.approx((1 + 0.5 * 31) / 32)
+    assert vals[2] == pytest.approx(1.0)
+    assert vals[2] > vals[3] > vals[4]
+    assert vals[4] == pytest.approx(1 / 32)
+
+
+def test_cosine_with_warmup_shape():
+    s = make_schedule(
+        {"type": "cosine_with_warmup", "warmup_steps": 10, "total_steps": 110}
+    )
+    vals = _eval(s, [0, 5, 10, 60, 110, 200])
+    np.testing.assert_allclose(
+        vals, [0.0, 0.5, 1.0, 0.5, 0.0, 0.0], atol=1e-6
+    )
+
+
+def test_polynomial_decay_power_and_floor():
+    s = make_schedule(
+        {"type": "polynomial_decay", "warmup_steps": 0, "total_steps": 100,
+         "power": 2.0, "end_factor": 0.1}
+    )
+    vals = _eval(s, [0, 50, 100, 150])
+    assert vals[0] == pytest.approx(1.0)
+    assert vals[1] == pytest.approx(0.25 * 0.9 + 0.1)
+    assert vals[2] == pytest.approx(0.1)
+    assert vals[3] == pytest.approx(0.1)  # holds the floor
+
+
+def test_unknown_types_raise():
+    with pytest.raises(ValueError):
+        make_schedule({"type": "nope"})
+    with pytest.raises(ValueError):
+        make_schedule({"type": "slanted_triangular"})  # needs num_steps
+    with pytest.raises(ValueError):
+        make_momentum_schedule({"type": "nope"})
+
+
+def test_inverted_triangular_momentum():
+    s = make_momentum_schedule(
+        {"type": "inverted_triangular", "cooldown_steps": 10,
+         "warmup_steps": 10, "low": 0.5},
+        base=0.9,
+    )
+    vals = _eval(s, [0, 5, 10, 15, 20, 100])
+    np.testing.assert_allclose(
+        vals, [0.9, 0.7, 0.5, 0.7, 0.9, 0.9], atol=1e-6
+    )
+
+
+def _tiny_params():
+    import jax.numpy as jnp
+
+    return {"bert": {"w": jnp.ones((3,))}, "head": {"w": jnp.ones((3,))}}
+
+
+def test_optimizer_with_cosine_schedule_steps():
+    import jax
+    import jax.numpy as jnp
+
+    params = _tiny_params()
+    tx, state = make_optimizer(
+        params,
+        lr_schedule={"type": "cosine_with_warmup", "warmup_steps": 2,
+                     "total_steps": 10},
+        warmup_steps=2,
+        total_steps=10,
+    )
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    updates, state = tx.update(grads, state, params)
+    # step 0: warmup scale 0 → zero update everywhere
+    assert all(
+        float(jnp.abs(u).max()) == 0.0
+        for u in jax.tree_util.tree_leaves(updates)
+    )
+    updates, state = tx.update(grads, state, params)
+    assert any(
+        float(jnp.abs(u).max()) > 0.0
+        for u in jax.tree_util.tree_leaves(updates)
+    )
+
+
+def test_optimizer_momentum_schedule_changes_trajectory():
+    """An inverted-triangular b1 must produce a different second-step
+    update than constant momentum on a sign-flipping gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(momentum_schedule):
+        params = _tiny_params()
+        tx, state = make_optimizer(
+            params, momentum_schedule=momentum_schedule, warmup_steps=0
+        )
+        g1 = jax.tree_util.tree_map(jnp.ones_like, params)
+        g2 = jax.tree_util.tree_map(lambda x: -jnp.ones_like(x), params)
+        _, state = tx.update(g1, state, params)
+        upd, _ = tx.update(g2, state, params)
+        return np.asarray(upd["head"]["w"])
+
+    base = run(None)
+    scheduled = run(
+        {"type": "inverted_triangular", "cooldown_steps": 2,
+         "warmup_steps": 2, "low": 0.2}
+    )
+    assert not np.allclose(base, scheduled)
+
+
+def test_trainer_config_accepts_scheduler_specs(tmp_path):
+    """The dataclass fields exist and flow through (config-drift guard for
+    the new slots)."""
+    from memvul_tpu.training.single_trainer import ClassifierTrainerConfig
+    from memvul_tpu.training.trainer import TrainerConfig
+
+    for cls in (TrainerConfig, ClassifierTrainerConfig):
+        cfg = cls(
+            learning_rate_scheduler={"type": "cosine_with_warmup",
+                                     "total_steps": 100},
+            momentum_scheduler={"type": "inverted_triangular"},
+        )
+        assert cfg.learning_rate_scheduler["type"] == "cosine_with_warmup"
