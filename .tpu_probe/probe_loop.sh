@@ -1,0 +1,23 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every 4 minutes until it answers; log status.
+LOG=/root/repo/.tpu_probe/probe.log
+OK=/root/repo/.tpu_probe/ALIVE
+rm -f "$OK"
+while true; do
+  TS=$(date +%H:%M:%S)
+  OUT=$(timeout 75 python - <<'PY' 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128))
+print("SUM", float((x@x).sum()))
+PY
+)
+  RC=$?
+  if [ $RC -eq 0 ] && echo "$OUT" | grep -q "SUM"; then
+    echo "$TS ALIVE: $OUT" >> "$LOG"
+    date > "$OK"
+    exit 0
+  else
+    echo "$TS dead rc=$RC" >> "$LOG"
+  fi
+  sleep 240
+done

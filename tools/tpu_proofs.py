@@ -31,6 +31,9 @@ sys.path.insert(0, str(REPO))
 
 RESULTS = REPO / "TPU_PROOFS.json"
 SMOKE = REPO / "SMOKE.md"
+# hand-written operational notes (outages, methodology caveats) survive
+# regeneration by living in their own file, embedded under the title
+NOTES = REPO / "smoke_notes.md"
 
 
 def _record(kind: str, payload: dict) -> None:
@@ -579,9 +582,10 @@ def write_smoke_md(results_path: Path = RESULTS, out_path: Path = SMOKE) -> None
     if not results_path.exists():
         return
     rows = [json.loads(l) for l in results_path.read_text().splitlines() if l.strip()]
-    lines = [
-        "# TPU hardware proofs",
-        "",
+    lines = ["# TPU hardware proofs", ""]
+    if NOTES.exists():
+        lines += [NOTES.read_text().strip(), ""]
+    lines += [
         "Recorded by `tools/tpu_proofs.py` on real TPU hardware (backend/"
         "device noted per row). Regenerate: `python tools/tpu_proofs.py all`.",
         "",
