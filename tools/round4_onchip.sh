@@ -48,6 +48,7 @@ step bench_auto6   1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=auto BENCH_BUCKET
 step bench_auto8   1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=auto BENCH_BUCKET_COUNT=8 BENCH_REPORTS=16384 python bench.py
 step bench_hand16k 1800 env BENCH_DEVICE_WAIT=60 BENCH_REPORTS=16384 python bench.py
 step bench_inflight4 1800 env BENCH_DEVICE_WAIT=60 BENCH_INFLIGHT=4 BENCH_REPORTS=16384 python bench.py
+step bench_tokens512k 1800 env BENCH_DEVICE_WAIT=60 BENCH_TOKENS=524288 BENCH_REPORTS=16384 python bench.py
 
 # 3. flash-vs-xla at workload lengths (bench-level A/B; kernel-level in proofs)
 step bench_flash   1800 env BENCH_DEVICE_WAIT=60 BENCH_ATTENTION=flash BENCH_REPORTS=16384 python bench.py
