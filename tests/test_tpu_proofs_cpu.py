@@ -120,3 +120,23 @@ def test_hbm_fields_absent_stats_are_none():
     f = tpu_proofs._hbm_fields({"peak_bytes_in_use": 2e9, "bytes_limit": 16e9})
     assert f["peak_hbm_gb"] == pytest.approx(2.0)
     assert f["hbm_limit_gb"] == pytest.approx(16.0)
+
+
+def test_streaming_rehearsal_tiny_cpu(tmp_path, monkeypatch):
+    """The full predict_file scale rehearsal (writer thread included)
+    runs end-to-end at tiny geometry and records its proof row."""
+    monkeypatch.setattr(tpu_proofs, "RESULTS", tmp_path / "proofs.json")
+    monkeypatch.setattr(tpu_proofs, "SMOKE", tmp_path / "SMOKE.md")
+    import streaming_rehearsal
+
+    payload = streaming_rehearsal.run(
+        [256, 1024], "tiny", seq_len=64, tokens_per_batch=4096
+    )
+    assert payload["large_over_small_rps"] > 0.9
+    assert all(r["result_lines"] > 0 for r in payload["rows"])
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "proofs.json").read_text().splitlines()
+    ]
+    assert rows[-1]["kind"] == "streaming_scale"
+    assert "Corpus-scale streaming" in (tmp_path / "SMOKE.md").read_text()

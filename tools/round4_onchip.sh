@@ -53,9 +53,9 @@ step bench_tokens512k 1800 env BENCH_DEVICE_WAIT=60 BENCH_TOKENS=524288 BENCH_RE
 # 3. flash-vs-xla at workload lengths (bench-level A/B; kernel-level in proofs)
 step bench_flash   1800 env BENCH_DEVICE_WAIT=60 BENCH_ATTENTION=flash BENCH_REPORTS=16384 python bench.py
 
-# 4. streaming rehearsal: does 100k sustain 16k's rate? (bench_hand16k above
-#    is the 16k side; this is the 100k side, same config)
-step bench_100k    4800 env BENCH_DEVICE_WAIT=60 BENCH_REPORTS=102400 python bench.py
+# 4. streaming rehearsal: the FULL predict_file path (writer thread and
+#    all) at 16k vs 102k — reports/s must stay flat
+step streaming     7200 python tools/streaming_rehearsal.py
 
 # 5. hardware proofs (flash now covers 256/512; trainab = MFU levers;
 #    bf16drift = score-drift bound)

@@ -1,0 +1,155 @@
+"""Corpus-scale streaming rehearsal (round-3 verdict #6).
+
+Drives the FULL ``predict_file`` path — streaming ``.jsonl`` reader
+(data/readers.py::_iter_corpus), normalize + tokenize, bucketed batching,
+async device dispatch, the writer thread serializing one ~129-float dict
+per report, and the threshold-swept metrics — at two corpus scales, and
+asserts the host pipeline sustains device throughput as the corpus grows
+(the writer thread and tokenizer had never been exercised above toy sizes
+on hardware).  This is the predict-side scale story for the reference's
+1.2M-report job (predict_memory.py:92-110).
+
+    python tools/streaming_rehearsal.py                  # base model, 16k vs 102k
+    python tools/streaming_rehearsal.py --model tiny --sizes 2048,8192   # CPU
+
+Records one ``streaming_scale`` row in TPU_PROOFS.json and regenerates
+SMOKE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def run(sizes, model_preset: str, seq_len: int, tokens_per_batch: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from memvul_tpu.utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    from memvul_tpu.data.readers import MemoryReader
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+    from memvul_tpu.models import BertConfig, MemoryModel
+
+    n_max = max(sizes)
+    ws = build_workspace(
+        tempfile.mkdtemp(prefix="streaming_"),
+        seed=0,
+        num_projects=8,
+        reports_per_project=max(4, min(n_max, 16384) // 8),
+        realistic_lengths=True,
+    )
+    if model_preset == "tiny":
+        cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+        seq_len = min(seq_len, cfg.max_position_embeddings)
+    else:
+        cfg = BertConfig.base(
+            vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
+        )
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+
+    # materialize .jsonl corpora of exactly the requested sizes by cycling
+    # the synthetic test split's RAW records (predict_file re-reads from
+    # disk each time — the streaming path under test)
+    raw = json.loads(Path(ws["paths"]["test"]).read_text())
+    corpus_files = {}
+    for n in sizes:
+        path = Path(ws["paths"]["test"]).parent / f"test_stream_{n}.jsonl"
+        with open(path, "w", encoding="utf-8") as f:
+            for i in range(n):
+                f.write(json.dumps(raw[i % len(raw)]) + "\n")
+        corpus_files[n] = str(path)
+
+    predictor = SiamesePredictor(
+        model,
+        params,
+        ws["tokenizer"],
+        batch_size=tokens_per_batch // seq_len,
+        max_length=seq_len,
+        buckets=tuple(b for b in (64, 128, 256, 512) if b <= seq_len) or (seq_len,),
+        tokens_per_batch=tokens_per_batch,
+    )
+    anchors = [
+        {"text1": text, "meta": {"label": f"{cat}#{i}", "type": "golden"}}
+        for i, (cat, text) in enumerate(
+            (list(ws["anchors"].items()) * 20)[:129]
+        )
+    ]
+    predictor.encode_anchors(anchors)
+
+    rows = []
+    for n in sorted(sizes):
+        out = Path(tempfile.mkdtemp()) / f"result_{n}.jsonl"
+        # warmup pass on the SMALLEST corpus only (compile one program per
+        # bucket + prime the tokenizer cache exactly as bench.py does)
+        if not rows:
+            predictor.predict_file(reader, corpus_files[n], out)
+        t0 = time.perf_counter()
+        metrics = predictor.predict_file(reader, corpus_files[n], out)
+        elapsed = time.perf_counter() - t0
+        lines = sum(1 for _ in open(out))
+        rows.append(
+            {
+                "n_reports": n,
+                "reports_per_s": metrics["num_samples"] / elapsed,
+                "elapsed_s": elapsed,
+                "result_lines": lines,
+                "num_samples": metrics["num_samples"],
+            }
+        )
+        print(f"streaming {n}: {rows[-1]['reports_per_s']:.1f} reports/s")
+
+    small, large = rows[0], rows[-1]
+    ratio = large["reports_per_s"] / small["reports_per_s"]
+    payload = {
+        "model": f"bert-{model_preset}",
+        "seq_len": seq_len,
+        "rows": rows,
+        "large_over_small_rps": ratio,
+    }
+    import tpu_proofs
+
+    tpu_proofs._record("streaming_scale", payload)
+    tpu_proofs.write_smoke_md()
+    # the acceptance: throughput at the large scale within 10% of small
+    # (no host-side sag as the corpus grows)
+    assert ratio > 0.9, payload
+    return payload
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="16384,102400")
+    ap.add_argument("--model", default="base", choices=("base", "tiny"))
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--tokens", type=int, default=256 * 1024)
+    args = ap.parse_args(argv)
+    sizes = [int(s) for s in args.sizes.split(",")]
+    run(sizes, args.model, args.seq_len, args.tokens)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
