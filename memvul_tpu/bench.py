@@ -79,9 +79,10 @@ def _run_bench() -> None:
     import numpy as np
     import jax
 
-    from memvul_tpu.utils.platform import honor_platform_env
+    from memvul_tpu.utils.platform import enable_compilation_cache, honor_platform_env
 
     honor_platform_env()
+    enable_compilation_cache()
     import jax.numpy as jnp
 
     from memvul_tpu.data.synthetic import build_workspace

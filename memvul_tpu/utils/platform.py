@@ -28,6 +28,28 @@ def honor_platform_env() -> None:
         jax.config.update("jax_platforms", requested)
 
 
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Point jax at a persistent compilation cache so separate processes
+    (bench child, each proof runner, the driver's round-end bench) reuse
+    each other's XLA executables instead of paying the 20-40 s per-program
+    TPU compile again.  ``JAX_COMPILATION_CACHE_DIR`` wins if set; no-op
+    if the backend/plugin cannot serialize executables."""
+    import os
+
+    cache_dir = (
+        os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or path
+        or os.path.expanduser("~/.cache/memvul_jax")
+    )
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:  # pragma: no cover — older jax / exotic plugin
+        pass
+
+
 def is_tpu_backend() -> bool:
     """True when the default JAX backend drives TPU hardware, regardless
     of the platform name it registered under."""

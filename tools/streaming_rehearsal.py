@@ -35,9 +35,10 @@ def run(sizes, model_preset: str, seq_len: int, tokens_per_batch: int) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from memvul_tpu.utils.platform import honor_platform_env
+    from memvul_tpu.utils.platform import enable_compilation_cache, honor_platform_env
 
     honor_platform_env()
+    enable_compilation_cache()
 
     from memvul_tpu.data.readers import MemoryReader
     from memvul_tpu.data.synthetic import build_workspace

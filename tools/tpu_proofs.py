@@ -735,6 +735,9 @@ _RUNNERS = {
 
 
 def main(argv=None) -> int:
+    from memvul_tpu.utils.platform import enable_compilation_cache
+
+    enable_compilation_cache()
     args = argv if argv is not None else sys.argv[1:]
     wanted = list(args) or ["all"]
     if wanted == ["all"]:
