@@ -29,6 +29,8 @@ a corpus length sample, BENCH_BUCKET_COUNT of them, default 6; empty
 string = pad-everything-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
 BENCH_REPORTS (default 16384), BENCH_ATTENTION (xla | flash, default xla),
+BENCH_QUANT (int8_dynamic — route dense contractions through the MXU's
+int8 path; same params, numerics bounded by the quantdrift proof),
 BENCH_MODEL (base | tiny — tiny is plumbing-validation only),
 BENCH_INFLIGHT (async device dispatch depth, default 2),
 BENCH_PROFILE (dir — capture a jax.profiler trace of the timed pass).
@@ -137,6 +139,9 @@ def _run_bench() -> None:
     attn = os.environ.get("BENCH_ATTENTION", "xla")
     if attn != "xla":
         cfg = cfg.replace(attention_impl=attn)
+    quant = os.environ.get("BENCH_QUANT")
+    if quant:
+        cfg = cfg.replace(quant=quant)
     model = MemoryModel(cfg)
     dummy = {
         "input_ids": np.zeros((2, 8), np.int32),

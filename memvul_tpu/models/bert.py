@@ -47,6 +47,11 @@ class BertConfig:
     # (reference: custom_PTM_embedder.py:107-118; unused by every shipped
     # reference config, provided for drop-in parity)
     last_layer_only: bool = True
+    # "int8_dynamic" routes the encoder's dense contractions through the
+    # MXU's native int8 path (inference-only speedup; same params/
+    # checkpoints — quantization is a property of the forward).  None =
+    # full precision
+    quant: Optional[str] = None
 
     @classmethod
     def tiny(cls, vocab_size: int = 2048, **kw) -> "BertConfig":
@@ -91,6 +96,35 @@ def _dense_init(config: BertConfig):
     return nn.initializers.normal(stddev=config.initializer_range)
 
 
+def _dense(c: BertConfig, features: int, name: str):
+    """nn.Dense, or its dynamic-int8 twin when ``c.quant`` asks for it
+    (identical param tree either way)."""
+    if c.quant == "int8_dynamic":
+        from ..ops.quant import QuantDense
+
+        return QuantDense(
+            features, dtype=c.dtype, kernel_init=_dense_init(c), name=name
+        )
+    if c.quant is not None:
+        raise ValueError(f"unknown quant mode {c.quant!r}")
+    return nn.Dense(features, kernel_init=_dense_init(c), dtype=c.dtype, name=name)
+
+
+def _dense_general(c: BertConfig, features, name: str, axis=-1):
+    if c.quant == "int8_dynamic":
+        from ..ops.quant import QuantDenseGeneral
+
+        return QuantDenseGeneral(
+            features, axis=axis, dtype=c.dtype, kernel_init=_dense_init(c),
+            name=name,
+        )
+    if c.quant is not None:
+        raise ValueError(f"unknown quant mode {c.quant!r}")
+    return nn.DenseGeneral(
+        features, axis=axis, kernel_init=_dense_init(c), dtype=c.dtype, name=name
+    )
+
+
 class BertEmbeddings(nn.Module):
     config: BertConfig
 
@@ -129,10 +163,7 @@ class BertSelfAttention(nn.Module):
         head_dim = c.hidden_size // c.num_heads
 
         def qkv(name):
-            return nn.DenseGeneral(
-                (c.num_heads, head_dim), kernel_init=_dense_init(c),
-                dtype=c.dtype, name=name,
-            )(hidden)
+            return _dense_general(c, (c.num_heads, head_dim), name)(hidden)
 
         query, key, value = qkv("query"), qkv("key"), qkv("value")
         dropout_rng = None
@@ -143,10 +174,7 @@ class BertSelfAttention(nn.Module):
             dropout_rng=dropout_rng, dropout_rate=c.attention_dropout,
             deterministic=deterministic, impl=c.attention_impl,
         )
-        out = nn.DenseGeneral(
-            c.hidden_size, axis=(-2, -1), kernel_init=_dense_init(c),
-            dtype=c.dtype, name="output",
-        )(attn)
+        out = _dense_general(c, c.hidden_size, "output", axis=(-2, -1))(attn)
         out = nn.Dropout(c.hidden_dropout)(out, deterministic=deterministic)
         return nn.LayerNorm(
             epsilon=c.layer_norm_eps, dtype=c.dtype, name="output_LayerNorm"
@@ -160,14 +188,9 @@ class BertLayer(nn.Module):
     def __call__(self, hidden, bias, deterministic: bool):
         c = self.config
         hidden = BertSelfAttention(c, name="attention")(hidden, bias, deterministic)
-        inter = nn.Dense(
-            c.intermediate_size, kernel_init=_dense_init(c), dtype=c.dtype,
-            name="intermediate",
-        )(hidden)
+        inter = _dense(c, c.intermediate_size, "intermediate")(hidden)
         inter = nn.gelu(inter, approximate=False)
-        out = nn.Dense(
-            c.hidden_size, kernel_init=_dense_init(c), dtype=c.dtype, name="output"
-        )(inter)
+        out = _dense(c, c.hidden_size, "output")(inter)
         out = nn.Dropout(c.hidden_dropout)(out, deterministic=deterministic)
         return nn.LayerNorm(
             epsilon=c.layer_norm_eps, dtype=c.dtype, name="output_LayerNorm"

@@ -1,0 +1,118 @@
+"""Dynamic int8 matmul for inference — the v5e MXU runs int8 at ~2x its
+bf16 rate, and the scoring path (SURVEY §3.2: encode 1.2M reports) is
+MXU-bound at production batch sizes, so quantizing the encoder's dense
+layers buys throughput the reference's fp32/fp16 GPU path has no
+equivalent for.
+
+Scheme: per-row (token) dynamic activation scales x per-column weight
+scales — symmetric, zero-point-free, computed on the fly inside the
+jitted forward (no calibration pass, no separate checkpoint format; the
+same f32/bf16 params serve both paths).  The int8 x int8 -> int32
+``lax.dot_general`` lowers onto the MXU's native int8 path on TPU; on
+CPU it is exercised for numerics only.
+
+Accuracy: symmetric per-row/per-column dynamic quant on BERT-class
+encoders is the standard production recipe; the on-chip ``quantdrift``
+proof (tools/tpu_proofs.py) bounds the induced best-anchor-probability
+drift the same way the bf16 proof does.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INT8_MAX = 127.0
+
+
+def _rowwise_scales(x: jax.Array, eps: float = 1e-8) -> jax.Array:
+    """Per-last-axis-row symmetric scale: max|row| / 127."""
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    return jnp.maximum(absmax, eps) / INT8_MAX
+
+
+def quantize_rowwise(x: jax.Array):
+    """float [..., K] -> (int8 [..., K], f32 scales [..., 1])."""
+    x32 = x.astype(jnp.float32)
+    scales = _rowwise_scales(x32)
+    q = jnp.clip(jnp.round(x32 / scales), -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scales
+
+
+def int8_matmul(x: jax.Array, w: jax.Array, out_dtype=jnp.float32) -> jax.Array:
+    """``x [..., K] @ w [K, N]`` via dynamic int8: quantize x per row and
+    w per output column, contract in int8 -> int32 on the MXU, dequantize
+    with the outer product of scales."""
+    xq, xs = quantize_rowwise(x)                      # [..., K], [..., 1]
+    wq, ws = quantize_rowwise(w.astype(jnp.float32).T)  # [N, K], [N, 1]
+    acc = lax.dot_general(
+        xq,
+        wq.T,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                                  # [..., N] int32
+    return (acc.astype(jnp.float32) * xs * ws[:, 0]).astype(out_dtype)
+
+
+# -- flax layers (drop-in for the nn.Dense/DenseGeneral uses in bert.py) ----
+#
+# Param names and shapes are IDENTICAL to their flax counterparts, so one
+# checkpoint serves both the full-precision and the quantized path — the
+# quantization is a property of the forward, not of the weights.
+
+from typing import Any, Sequence, Tuple, Union  # noqa: E402
+
+from flax import linen as nn  # noqa: E402
+
+
+class QuantDense(nn.Module):
+    """nn.Dense with the contraction in dynamic int8."""
+
+    features: int
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features)
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        y = int8_matmul(x, kernel, out_dtype=self.dtype)
+        return y + bias.astype(self.dtype)
+
+
+class QuantDenseGeneral(nn.Module):
+    """nn.DenseGeneral with the contraction in dynamic int8 — supports the
+    two shapes bert.py uses: fan-out to (heads, head_dim) and fan-in from
+    ``axis=(-2, -1)``."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Tuple[int, ...]] = -1
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        features = (
+            (self.features,) if isinstance(self.features, int) else tuple(self.features)
+        )
+        axis = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        if sorted(a % x.ndim for a in axis) != list(
+            range(x.ndim - len(axis), x.ndim)
+        ):
+            raise ValueError(f"QuantDenseGeneral needs trailing axes, got {axis}")
+        in_shape = x.shape[x.ndim - len(axis):]
+        kernel = self.param(
+            "kernel", self.kernel_init, (*in_shape, *features)
+        )
+        bias = self.param("bias", nn.initializers.zeros, features)
+        k = math.prod(in_shape)
+        n = math.prod(features)
+        x2d = x.reshape(*x.shape[: x.ndim - len(axis)], k)
+        y = int8_matmul(x2d, kernel.reshape(k, n), out_dtype=self.dtype)
+        y = y.reshape(*x.shape[: x.ndim - len(axis)], *features)
+        return y + bias.astype(self.dtype)

@@ -1,0 +1,136 @@
+"""Dynamic int8 inference path (ops/quant.py, BertConfig.quant).
+
+The v5e MXU runs int8 at ~2x bf16; these tests pin the numerics and the
+checkpoint-compatibility contract on CPU (the speed claim is the on-chip
+bench A/B's job, BENCH_QUANT=int8_dynamic).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+
+from memvul_tpu.models import BertConfig, BertEncoder, MemoryModel
+from memvul_tpu.ops.quant import (
+    QuantDense,
+    QuantDenseGeneral,
+    int8_matmul,
+    quantize_rowwise,
+)
+
+CFG = BertConfig.tiny(vocab_size=512)
+QCFG = CFG.replace(quant="int8_dynamic")
+
+
+def test_quantize_rowwise_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 64)), jnp.float32)
+    q, s = quantize_rowwise(x)
+    assert q.dtype == jnp.int8
+    recon = q.astype(jnp.float32) * s
+    # symmetric 8-bit: error per element <= scale/2 = max|row|/254
+    bound = np.abs(np.asarray(x)).max(axis=-1, keepdims=True) / 254 + 1e-6
+    assert (np.abs(np.asarray(recon - x)) <= bound).all()
+
+
+def test_int8_matmul_close_to_f32():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 96, 128)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(128, 64)), jnp.float32)
+    exact = np.asarray(x @ w)
+    approx = np.asarray(int8_matmul(x, w))
+    rel = np.abs(approx - exact).max() / np.abs(exact).max()
+    assert rel < 0.05, rel
+
+
+def test_quant_dense_param_tree_matches_nn_dense():
+    x = jnp.ones((2, 16))
+    init = nn.initializers.normal(stddev=0.02)
+    p_ref = nn.Dense(8, kernel_init=init).init(jax.random.PRNGKey(0), x)
+    p_q = QuantDense(8, kernel_init=init).init(jax.random.PRNGKey(0), x)
+    assert jax.tree_util.tree_structure(p_ref) == jax.tree_util.tree_structure(p_q)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ref), jax.tree_util.tree_leaves(p_q)):
+        assert a.shape == b.shape
+    out_ref = nn.Dense(8, kernel_init=init).apply(p_ref, x)
+    out_q = QuantDense(8, kernel_init=init).apply(p_ref, x)  # same params!
+    np.testing.assert_allclose(
+        np.asarray(out_q), np.asarray(out_ref), rtol=0.05, atol=0.05
+    )
+
+
+@pytest.mark.parametrize(
+    "features,axis,shape",
+    [((4, 16), -1, (2, 10, 64)), (64, (-2, -1), (2, 10, 4, 16))],
+)
+def test_quant_dense_general_matches_nn(features, axis, shape):
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    init = nn.initializers.normal(stddev=0.05)
+    ref = nn.DenseGeneral(features, axis=axis, kernel_init=init)
+    quant = QuantDenseGeneral(features, axis=axis, kernel_init=init)
+    p = ref.init(jax.random.PRNGKey(0), x)
+    assert (
+        jax.tree_util.tree_structure(p)
+        == jax.tree_util.tree_structure(quant.init(jax.random.PRNGKey(0), x))
+    )
+    out_ref = np.asarray(ref.apply(p, x))
+    out_q = np.asarray(quant.apply(p, x))
+    rel = np.abs(out_q - out_ref).max() / (np.abs(out_ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def _batch(rng, cfg=CFG):
+    ids = jnp.asarray(rng.integers(4, cfg.vocab_size, (2, 24)), jnp.int32)
+    return ids, jnp.ones_like(ids)
+
+
+def test_quant_encoder_shares_checkpoints_and_tracks_f32():
+    """One param tree serves both paths; the quantized forward stays close
+    to full precision at tiny geometry."""
+    rng = np.random.default_rng(3)
+    ids, mask = _batch(rng)
+    enc = BertEncoder(CFG)
+    params = enc.init(jax.random.PRNGKey(0), ids, mask)
+    q_enc = BertEncoder(QCFG)
+    q_params = q_enc.init(jax.random.PRNGKey(0), ids, mask)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(
+        q_params
+    )
+    out = np.asarray(enc.apply(params, ids, mask)).ravel()
+    out_q = np.asarray(jax.jit(lambda p, i, m: q_enc.apply(p, i, m))(params, ids, mask)).ravel()
+    assert np.isfinite(out_q).all()
+    corr = np.corrcoef(out, out_q)[0, 1]
+    assert corr > 0.99, corr
+
+
+def test_quant_memory_model_scoring_decision_stability():
+    """Best-anchor argmax agreement between quantized and full-precision
+    scoring stays high at random init (the chain the quantdrift proof
+    bounds on-chip)."""
+    from memvul_tpu.models import best_anchor_score
+
+    rng = np.random.default_rng(4)
+    model = MemoryModel(CFG)
+    q_model = MemoryModel(QCFG)
+    ids, mask = _batch(rng)
+    s1 = {"input_ids": ids, "attention_mask": mask}
+    params = model.init(jax.random.PRNGKey(0), s1, s1)
+    anchors_tok = {
+        "input_ids": jnp.asarray(rng.integers(4, 500, (5, 24)), jnp.int32),
+        "attention_mask": jnp.ones((5, 24), jnp.int32),
+    }
+    bank = model.apply(params, anchors_tok, method="encode")
+    p_f, a_f = best_anchor_score(model.apply(params, s1, anchors=bank))
+    q_bank = q_model.apply(params, anchors_tok, method="encode")
+    p_q, a_q = best_anchor_score(q_model.apply(params, s1, anchors=q_bank))
+    assert np.isfinite(np.asarray(p_q)).all()
+    assert np.abs(np.asarray(p_q) - np.asarray(p_f)).max() < 0.15
+
+
+def test_unknown_quant_mode_raises():
+    bad = CFG.replace(quant="int4")
+    rng = np.random.default_rng(0)
+    ids, mask = _batch(rng, bad)
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        BertEncoder(bad).init(jax.random.PRNGKey(0), ids, mask)

@@ -140,3 +140,16 @@ def test_streaming_rehearsal_tiny_cpu(tmp_path, monkeypatch):
     ]
     assert rows[-1]["kind"] == "streaming_scale"
     assert "Corpus-scale streaming" in (tmp_path / "SMOKE.md").read_text()
+
+
+def test_quantdrift_tiny_cpu(tmp_path, monkeypatch):
+    monkeypatch.setattr(tpu_proofs, "RESULTS", tmp_path / "proofs.json")
+    payload = tpu_proofs.run_quantdrift(
+        A=5, N=16, B=8, L=32, preset="tiny", require_tpu=False
+    )
+    assert 0.0 <= payload["max_abs_dp"] < 0.3
+    rows = [
+        json.loads(l)
+        for l in (tmp_path / "proofs.json").read_text().splitlines()
+    ]
+    assert rows[-1]["kind"] == "int8_score_drift"

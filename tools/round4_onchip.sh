@@ -50,8 +50,10 @@ step bench_hand16k 1800 env BENCH_DEVICE_WAIT=60 BENCH_REPORTS=16384 python benc
 step bench_inflight4 1800 env BENCH_DEVICE_WAIT=60 BENCH_INFLIGHT=4 BENCH_REPORTS=16384 python bench.py
 step bench_tokens512k 1800 env BENCH_DEVICE_WAIT=60 BENCH_TOKENS=524288 BENCH_REPORTS=16384 python bench.py
 
-# 3. flash-vs-xla at workload lengths (bench-level A/B; kernel-level in proofs)
+# 3. flash-vs-xla at workload lengths (bench-level A/B; kernel-level in
+#    proofs) + the int8 MXU path A/B (numerics bounded by quantdrift)
 step bench_flash   1800 env BENCH_DEVICE_WAIT=60 BENCH_ATTENTION=flash BENCH_REPORTS=16384 python bench.py
+step bench_int8    1800 env BENCH_DEVICE_WAIT=60 BENCH_QUANT=int8_dynamic BENCH_REPORTS=16384 python bench.py
 
 # 4. streaming rehearsal: the FULL predict_file path (writer thread and
 #    all) at 16k vs 102k — reports/s must stay flat
@@ -65,5 +67,6 @@ step proofs_mlmsmoke  1800 python tools/tpu_proofs.py mlmsmoke
 step proofs_trainsmoke 1800 python tools/tpu_proofs.py trainsmoke
 step proofs_trainab   3600 python tools/tpu_proofs.py trainab
 step proofs_bf16drift 1800 python tools/tpu_proofs.py bf16drift
+step proofs_quantdrift 1800 python tools/tpu_proofs.py quantdrift
 
 echo "=== all steps done ($(date +%H:%M:%S)) — results in $LOG/ ==="

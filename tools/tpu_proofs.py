@@ -400,61 +400,47 @@ def run_trainab() -> dict:
     return payload
 
 
-def run_bf16drift(
-    A: int = 129,
-    N: int = 4096,
-    B: int = 256,
-    L: int = 256,
-    preset: str = "base",
-    require_tpu: bool = True,
+def _decision_drift(
+    variant_cfg,
+    A: int,
+    N: int,
+    B: int,
+    L: int,
+    preset: str,
 ) -> dict:
-    """Round-3 verdict #5: the missing link in the ±0.5-F1 parity
-    argument — how much do bf16 activations move the best-anchor
+    """Score N synthetic reports against an A-anchor bank with the f32
+    reference forward and with ``variant_cfg(base_cfg)``'s forward, both
+    driven by ONE f32 param set, and measure how far the best-anchor
     probability (the reference's decision value, predict_memory.py:
-    168-177) relative to f32, through the full encode → 129-way anchor
-    match → softmax-max chain?
-
-    Same f32 params drive both dtypes (dtype only sets activation
-    precision); reports and the bank are synthetic/random-init, so this
-    measures the numerical chain, not trained-model accuracy — the drift
-    bound is what the F1-parity argument needs."""
+    168-177) moves.  Shared by the bf16 and int8 drift proofs."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from memvul_tpu.models import BertConfig, MemoryModel
     from memvul_tpu.models.memory import best_anchor_score
-    from memvul_tpu.utils.platform import is_tpu_backend
 
-    if require_tpu:
-        assert is_tpu_backend(), "bf16 drift proof must run on TPU hardware"
-    # defaults: CWE-bank size, corpus sample, batch, workload length
     rng = np.random.default_rng(7)
-
-    def batches(n, length):
-        for lo in range(0, n, B):
-            m = min(B, n - lo)
-            ids = rng_ids[lo : lo + m, :length]
-            yield {
-                "input_ids": ids,
-                "attention_mask": np.ones_like(ids),
-            }
-
     rng_ids = rng.integers(1000, 30000, (N, L)).astype(np.int32)
     anchor_ids = rng.integers(1000, 30000, (A, L)).astype(np.int32)
     dummy = {
         "input_ids": np.zeros((2, 8), np.int32),
         "attention_mask": np.ones((2, 8), np.int32),
     }
-    # ONE f32 param set drives both dtypes (flax keeps param_dtype f32;
-    # cfg.dtype only sets activation precision)
+
+    def batches():
+        for lo in range(0, N, B):
+            ids = rng_ids[lo : lo + min(B, N - lo)]
+            yield {"input_ids": ids, "attention_mask": np.ones_like(ids)}
+
     make_cfg = getattr(BertConfig, preset)
-    params = MemoryModel(
-        make_cfg(vocab_size=30522, dtype=jnp.float32, scan_layers=True)
-    ).init(jax.random.PRNGKey(0), dummy, dummy)
+    base_cfg = make_cfg(vocab_size=30522, dtype=jnp.float32, scan_layers=True)
+    # ONE f32 param set drives both forwards (flax keeps param_dtype f32;
+    # cfg.dtype/quant only change the forward computation)
+    params = MemoryModel(base_cfg).init(jax.random.PRNGKey(0), dummy, dummy)
     results = {}
-    for dtype_name, dtype in (("float32", jnp.float32), ("bfloat16", jnp.bfloat16)):
-        model = MemoryModel(make_cfg(vocab_size=30522, dtype=dtype, scan_layers=True))
+    for name, cfg in (("reference", base_cfg), ("variant", variant_cfg(base_cfg))):
+        model = MemoryModel(cfg)
         encode = jax.jit(
             lambda p, s, model=model: model.apply(p, s, method="encode")
         )
@@ -468,17 +454,22 @@ def run_bf16drift(
             {"input_ids": anchor_ids, "attention_mask": np.ones_like(anchor_ids)},
         )
         probs, args_ = [], []
-        for batch in batches(N, L):
+        for batch in batches():
             p, a = match(params, batch, bank)
             probs.append(np.asarray(p, np.float32))
             args_.append(np.asarray(a))
-        results[dtype_name] = (np.concatenate(probs), np.concatenate(args_))
+        results[name] = (np.concatenate(probs), np.concatenate(args_))
+    return results
 
-    p32, a32 = results["float32"]
-    p16, a16 = results["bfloat16"]
+
+def _drift_payload(results, N: int, A: int, L: int, preset: str) -> dict:
+    import numpy as np
+
+    p32, a32 = results["reference"]
+    p16, a16 = results["variant"]
     drift = np.abs(p16 - p32)
     flips = int(((p16 >= 0.5) != (p32 >= 0.5)).sum())
-    payload = {
+    return {
         "model": f"bert-{preset}",
         "n_reports": N,
         "n_anchors": A,
@@ -492,8 +483,59 @@ def run_bf16drift(
         "note": "random-init params + synthetic tokens: bounds the numerical "
         "chain (encode -> 129-way match -> softmax max), not trained accuracy",
     }
+
+
+def run_bf16drift(
+    A: int = 129,
+    N: int = 4096,
+    B: int = 256,
+    L: int = 256,
+    preset: str = "base",
+    require_tpu: bool = True,
+) -> dict:
+    """Round-3 verdict #5: the missing link in the ±0.5-F1 parity
+    argument — how much do bf16 activations move the best-anchor
+    probability relative to f32, through the full encode → 129-way anchor
+    match → softmax-max chain?"""
+    import jax.numpy as jnp
+
+    from memvul_tpu.utils.platform import is_tpu_backend
+
+    if require_tpu:
+        assert is_tpu_backend(), "bf16 drift proof must run on TPU hardware"
+    results = _decision_drift(
+        lambda c: c.replace(dtype=jnp.bfloat16), A, N, B, L, preset
+    )
+    payload = _drift_payload(results, N, A, L, preset)
     _record("bf16_score_drift", payload)
     assert payload["max_abs_dp"] < 0.2, payload
+    return payload
+
+
+def run_quantdrift(
+    A: int = 129,
+    N: int = 4096,
+    B: int = 256,
+    L: int = 256,
+    preset: str = "base",
+    require_tpu: bool = True,
+) -> dict:
+    """Decision drift of the int8_dynamic inference path (bf16
+    activations + int8 dense contractions — the deployment combination
+    BENCH_QUANT=int8_dynamic benches) vs the f32 reference forward."""
+    import jax.numpy as jnp
+
+    from memvul_tpu.utils.platform import is_tpu_backend
+
+    if require_tpu:
+        assert is_tpu_backend(), "quant drift proof must run on TPU hardware"
+    results = _decision_drift(
+        lambda c: c.replace(dtype=jnp.bfloat16, quant="int8_dynamic"),
+        A, N, B, L, preset,
+    )
+    payload = _drift_payload(results, N, A, L, preset)
+    _record("int8_score_drift", payload)
+    assert payload["max_abs_dp"] < 0.3, payload
     return payload
 
 
@@ -668,9 +710,14 @@ def write_smoke_md(
                         f"| {row['first_step_s_incl_compile']:.1f} s |"
                     )
             lines.append("")
-        elif r["kind"] == "bf16_score_drift":
+        elif r["kind"] in ("bf16_score_drift", "int8_score_drift"):
+            what = (
+                "bf16 vs f32"
+                if r["kind"] == "bf16_score_drift"
+                else "int8_dynamic (bf16+int8 MXU) vs f32"
+            )
             lines += [
-                f"## bf16 vs f32 best-anchor score drift — {r['device_kind']}",
+                f"## {what} best-anchor score drift — {r['device_kind']}",
                 "",
                 f"{r['n_reports']} synthetic reports × {r['n_anchors']}-anchor bank, "
                 f"len {r['seq_len']}, shared f32 params (round-3 verdict #5 — the "
@@ -731,6 +778,7 @@ _RUNNERS = {
     "mlmsmoke": run_mlmsmoke,
     "trainab": run_trainab,
     "bf16drift": run_bf16drift,
+    "quantdrift": run_quantdrift,
 }
 
 
