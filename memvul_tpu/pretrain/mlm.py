@@ -231,7 +231,10 @@ class MLMTrainer:
     ) -> None:
         import optax
 
+        from ..training.trainer import _reject_inference_only_quant
+
         self.model = MLMModel(config)
+        _reject_inference_only_quant(self.model)
         self.tokenizer = tokenizer
         self.c = trainer_config or MLMTrainerConfig()
         self._continuation = continuation_flags(tokenizer)

@@ -127,6 +127,9 @@ class ClassifierTrainer:
         self.train_path = str(train_path)
         self.validation_path = str(validation_path) if validation_path else None
         self.mesh = mesh
+        from .trainer import _reject_inference_only_quant
+
+        _reject_inference_only_quant(model)
 
         c = self.config
         self.encoder = CachedEncoder(tokenizer, max_length=c.max_length)

@@ -51,6 +51,20 @@ logger = logging.getLogger(__name__)
 _host_fetch = jax.device_get
 
 
+def _reject_inference_only_quant(model) -> None:
+    """int8_dynamic rounds/clips inside the forward, so gradients through
+    the quantized contractions are zero — a trainer fed such a model would
+    silently not learn.  Fail loudly instead; train full-precision and
+    enable quant at evaluation time (same checkpoint serves both)."""
+    quant = getattr(getattr(model, "config", None), "quant", None)
+    if quant is not None:
+        raise ValueError(
+            f"encoder quant={quant!r} is inference-only (zero gradient "
+            "through round/clip); train without quant and enable it on the "
+            "evaluation config instead"
+        )
+
+
 def make_train_step(model: MemoryModel, tx, ema_decay: Optional[float] = None):
     """Build the fused optimizer step: grad accumulation over a [K, B, ...]
     microbatch stack via ``lax.scan``, then one parameter-group AdamW
@@ -223,6 +237,7 @@ class MemoryTrainer:
         self.validation_path = str(validation_path) if validation_path else None
         self.anchor_path = str(anchor_path) if anchor_path else None
         self.mesh = mesh
+        _reject_inference_only_quant(model)
 
         c = self.config
         self.encoder = CachedEncoder(tokenizer, max_length=c.max_length)

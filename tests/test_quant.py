@@ -134,3 +134,20 @@ def test_unknown_quant_mode_raises():
     ids, mask = _batch(rng, bad)
     with pytest.raises(ValueError, match="unknown quant mode"):
         BertEncoder(bad).init(jax.random.PRNGKey(0), ids, mask)
+
+
+def test_trainers_reject_inference_only_quant():
+    from memvul_tpu.training.trainer import _reject_inference_only_quant
+
+    with pytest.raises(ValueError, match="inference-only"):
+        _reject_inference_only_quant(MemoryModel(QCFG))
+    _reject_inference_only_quant(MemoryModel(CFG))  # no quant: fine
+
+
+def test_mlm_trainer_rejects_quant_config(tmp_path):
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.pretrain.mlm import MLMTrainer, MLMTrainerConfig
+
+    ws = build_workspace(tmp_path, seed=5)
+    with pytest.raises(ValueError, match="inference-only"):
+        MLMTrainer(QCFG, ws["tokenizer"], MLMTrainerConfig())

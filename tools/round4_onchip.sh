@@ -70,3 +70,11 @@ step proofs_bf16drift 1800 python tools/tpu_proofs.py bf16drift
 step proofs_quantdrift 1800 python tools/tpu_proofs.py quantdrift
 
 echo "=== all steps done ($(date +%H:%M:%S)) — results in $LOG/ ==="
+
+# durability: the round may end (or the tunnel re-wedge) at any moment —
+# commit the proof artifacts and sweep logs as soon as they exist
+git add TPU_PROOFS.json SMOKE.md "$LOG" 2>/dev/null
+if ! git diff --cached --quiet 2>/dev/null; then
+  git commit -q -m "On-chip round-4 results: bench sweep + hardware proofs (auto-committed by round4_onchip.sh)"
+  echo "artifacts committed"
+fi
