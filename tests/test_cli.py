@@ -381,3 +381,37 @@ def test_online_resample_off_freezes_pairs(ws, tmp_path):
     assert len(first) == len(second)
     for a, b in zip(first, second):
         np.testing.assert_array_equal(a, b)
+
+
+def test_cli_evaluate_with_int8_quant_override(ws, tmp_path):
+    """The shipped int8 eval config drives the quantized scoring path on
+    an archived full-precision model: same checkpoint, metric files come
+    out, quant flag actually reaches the rebuilt model."""
+    config = tiny_memory_config(ws)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+
+    shipped = loads_config(
+        (CONFIGS_DIR / "test_config_memory_int8.json").read_text()
+    )
+    assert shipped["model"]["encoder"]["quant"] == "int8_dynamic"
+    overrides = {
+        "model": {"encoder": {"quant": "int8_dynamic"}},  # dtype: keep tiny default
+        "evaluation": {"batch_size": 8, "max_length": 48},
+    }
+    eval_dir = tmp_path / "eval_int8"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(eval_dir), "--name", "memvul", "--no-mesh",
+        "--overrides", json.dumps(overrides),
+    ])
+    assert rc == 0
+    metrics = json.loads((eval_dir / "memvul_metric_all.json").read_text())
+    for key in ("TP", "FN", "TN", "FP", "prec", "f1", "auc"):
+        assert key in metrics
+
+    arch = load_archive(ser_dir, overrides=overrides)
+    model = build_model(dict(arch.config["model"]), arch.tokenizer.vocab_size)
+    assert model.config.quant == "int8_dynamic"
