@@ -20,9 +20,11 @@ drift the same way the bf16 proof does.
 from __future__ import annotations
 
 import math
+from typing import Any, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from flax import linen as nn
 from jax import lax
 
 INT8_MAX = 127.0
@@ -62,10 +64,6 @@ def int8_matmul(x: jax.Array, w: jax.Array, out_dtype=jnp.float32) -> jax.Array:
 # Param names and shapes are IDENTICAL to their flax counterparts, so one
 # checkpoint serves both the full-precision and the quantized path — the
 # quantization is a property of the forward, not of the weights.
-
-from typing import Any, Sequence, Tuple, Union  # noqa: E402
-
-from flax import linen as nn  # noqa: E402
 
 
 class QuantDense(nn.Module):

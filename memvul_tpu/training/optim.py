@@ -92,37 +92,30 @@ def make_schedule(spec: Dict) -> optax.Schedule:
 
         return stlr
 
-    if kind == "cosine_with_warmup":
+    def warmup_then(decay):
+        """Linear warmup to 1, then ``decay(progress)`` where progress
+        runs 0→1 (clipped) over the post-warmup steps — the scaffolding
+        cosine and polynomial share."""
         if total is None:
-            raise ValueError("cosine_with_warmup needs total_steps")
+            raise ValueError(f"{kind} needs total_steps")
 
-        def cosine(step):
+        def schedule(step):
             t = jnp.asarray(step, jnp.float32)
             warm = t / jnp.maximum(1.0, warmup)
             progress = jnp.clip(
                 (t - warmup) / jnp.maximum(1.0, float(total) - warmup), 0.0, 1.0
             )
-            after = 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
-            return jnp.where(t < warmup, warm, after)
+            return jnp.where(t < warmup, warm, decay(progress))
 
-        return cosine
+        return schedule
+
+    if kind == "cosine_with_warmup":
+        return warmup_then(lambda p: 0.5 * (1.0 + jnp.cos(jnp.pi * p)))
 
     if kind == "polynomial_decay":
-        if total is None:
-            raise ValueError("polynomial_decay needs total_steps")
         power = float(spec.get("power", 1.0))
         end = float(spec.get("end_factor", 0.0))
-
-        def poly(step):
-            t = jnp.asarray(step, jnp.float32)
-            warm = t / jnp.maximum(1.0, warmup)
-            progress = jnp.clip(
-                (t - warmup) / jnp.maximum(1.0, float(total) - warmup), 0.0, 1.0
-            )
-            after = (1.0 - progress) ** power * (1.0 - end) + end
-            return jnp.where(t < warmup, warm, after)
-
-        return poly
+        return warmup_then(lambda p: (1.0 - p) ** power * (1.0 - end) + end)
 
     raise ValueError(f"unknown schedule type {kind!r}")
 
