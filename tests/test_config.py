@@ -237,3 +237,30 @@ def test_jsonnet_trailing_commas_dropped_outside_strings():
 def test_comment_containing_quotes_does_not_open_string():
     cfg = loads_config('{\n// shards on "model", batches on "data"\n"a": 1, // "x"\n"b": 2}')
     assert cfg == {"a": 1, "b": 2}
+
+
+def test_jsonnet_parser_is_identity_on_valid_json():
+    """Property: for ANY valid JSON document, loads_config == json.loads
+    (the Jsonnet tolerance must never change the meaning of plain JSON —
+    strings containing '//', 'local', semicolons, bound-looking
+    identifiers, commas before brackets, etc.)."""
+    from hypothesis import given, settings, strategies as st
+
+    json_values = st.recursive(
+        st.none()
+        | st.booleans()
+        | st.integers(min_value=-(2**31), max_value=2**31)
+        | st.floats(allow_nan=False, allow_infinity=False)
+        | st.text(max_size=40),
+        lambda children: st.lists(children, max_size=4)
+        | st.dictionaries(st.text(max_size=10), children, max_size=4),
+        max_leaves=20,
+    )
+
+    @settings(max_examples=200, deadline=None)
+    @given(json_values)
+    def check(value):
+        text = json.dumps(value)
+        assert loads_config(text) == json.loads(text)
+
+    check()

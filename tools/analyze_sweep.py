@@ -1,0 +1,118 @@
+"""Summarize a round4_onchip.sh sweep into a decision table.
+
+Reads each ``<logdir>/bench_*.out`` (one bench JSON line per file) plus
+the latest proof rows in TPU_PROOFS.json, prints a ranked table, and
+states the three decisions the round-3 verdict asks for:
+
+* bucket policy (hand 64/128/256/512 vs auto-6 vs auto-8) + inflight/tokens
+* flash vs xla at workload lengths
+* int8 vs bf16 (gated on the quantdrift numbers)
+
+Pure reporting — flipping shipped defaults stays a human commit.
+
+    python tools/analyze_sweep.py [round4_logs]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def last_json_line(path: Path):
+    if not path.exists():
+        return None
+    for line in reversed(path.read_text().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    logdir = REPO / (args[0] if args else "round4_logs")
+    if not logdir.exists():
+        print(f"no sweep logs at {logdir}")
+        return 1
+
+    rows = []
+    for out in sorted(logdir.glob("bench_*.out")):
+        rec = last_json_line(out)
+        if rec and "value" in rec:
+            rows.append((out.stem, rec["value"], rec.get("vs_baseline")))
+        else:
+            rows.append((out.stem, None, None))
+    if rows:
+        print(f"{'step':24} {'reports/s':>10} {'vs_baseline':>12}")
+        ok = [r for r in rows if r[1] is not None]
+        for name, value, vs in sorted(
+            rows, key=lambda r: -(r[1] or 0)
+        ):
+            v = f"{value:.1f}" if value is not None else "FAILED"
+            b = f"{vs:.2f}x" if vs is not None else ""
+            print(f"{name:24} {v:>10} {b:>12}")
+        if ok:
+            best = max(ok, key=lambda r: r[1])
+            print(f"\nbest: {best[0]} at {best[1]:.1f} reports/s")
+
+    proofs = REPO / "TPU_PROOFS.json"
+    if proofs.exists():
+        latest = {}
+        for line in proofs.read_text().splitlines():
+            if line.strip():
+                rec = json.loads(line)
+                latest[rec["kind"]] = rec
+        flash = latest.get("flash_parity_timing")
+        if flash:
+            short = [r for r in flash["rows"] if r["seq_len"] in (256, 512)]
+            if short:
+                wins = [
+                    r for r in short
+                    if (r.get("speedup_vs_xla") or 0) > 1.05
+                ]
+                print(
+                    "\nflash @256/512: "
+                    + ", ".join(
+                        f"{r['seq_len']}→{r['speedup_vs_xla']:.2f}x"
+                        if r.get("speedup_vs_xla")
+                        else f"{r['seq_len']}→below-noise"
+                        for r in short
+                    )
+                    + ("  → FLIP default to flash" if len(wins) == len(short)
+                       else "  → keep xla at workload lengths")
+                )
+        drift = latest.get("int8_score_drift")
+        if drift:
+            ok_drift = (
+                drift["max_abs_dp"] < 0.05 and drift["flip_rate"] < 0.005
+            )
+            print(
+                f"int8 drift: max|dp|={drift['max_abs_dp']:.4f} "
+                f"flips={drift['flip_rate']*100:.2f}%"
+                + ("  → int8 default is defensible" if ok_drift
+                   else "  → keep full precision as default")
+            )
+        ab = latest.get("train_ab_base_geometry")
+        if ab:
+            timed = [
+                r for r in ab["rows"]
+                if "steady_step_mean_s" in r
+            ]
+            if timed:
+                best = min(timed, key=lambda r: r["steady_step_mean_s"])
+                print(
+                    f"train A/B best: {best['variant']} at "
+                    f"{best['steady_step_mean_s']*1e3:.0f} ms/step"
+                )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
