@@ -21,8 +21,12 @@ import jax, jax.numpy as jnp
 x = jnp.ones((128,128)); print('alive', float((x@x).sum()))" >/dev/null 2>&1
 }
 
-step() { # step <name> <timeout_s> <cmd...>
+step() { # step <name> <timeout_s> <cmd...>  (resumable: skips on .done)
   local name=$1 tmo=$2; shift 2
+  if [ -f "$LOG/$name.done" ]; then
+    echo "=== $name already done — skipping ==="
+    return 0
+  fi
   echo "=== $name ($(date +%H:%M:%S)) ==="
   if ! probe; then
     echo "TUNNEL DEAD before $name — aborting remaining steps" | tee "$LOG/ABORTED"
@@ -36,9 +40,14 @@ step() { # step <name> <timeout_s> <cmd...>
     sleep 5
   fi
   wait $pid 2>/dev/null
-  echo "rc=$? -> $LOG/$name.out"
+  local rc=$?
+  echo "rc=$rc -> $LOG/$name.out"
   tail -1 "$LOG/$name.out"
+  if [ $rc -eq 0 ]; then
+    date > "$LOG/$name.done"
+  fi
 }
+rm -f "$LOG/ABORTED"
 
 # 1. the headline number, default config (matches what the driver runs)
 step bench_default 2400 env BENCH_DEVICE_WAIT=60 python bench.py

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Probe the axon tunnel every 4 min; while it answers, run the (resumable)
+# round-4 on-chip agenda in the foreground; if the agenda aborts on a
+# re-wedge, go back to probing.  Exits only when the agenda completes.
+cd /root/repo
+LOG=/root/repo/.tpu_probe/probe.log
+while true; do
+  TS=$(date +%H:%M:%S)
+  OUT=$(timeout 75 python - <<'PY' 2>&1
+import jax, jax.numpy as jnp
+x = jnp.ones((128,128))
+print("SUM", float((x@x).sum()))
+PY
+)
+  RC=$?
+  if [ $RC -eq 0 ] && echo "$OUT" | grep -q "SUM"; then
+    echo "$TS ALIVE — running round4_onchip.sh" >> "$LOG"
+    date > /root/repo/.tpu_probe/ALIVE
+    bash tools/round4_onchip.sh round4_logs >> /root/repo/round4_logs_driver.log 2>&1
+    AGENDA_RC=$?
+    echo "$(date +%H:%M:%S) agenda rc=$AGENDA_RC" >> "$LOG"
+    if [ $AGENDA_RC -eq 0 ]; then
+      exit 0
+    fi
+    sleep 120   # re-wedged mid-agenda: back to probing
+  else
+    echo "$TS dead rc=$RC" >> "$LOG"
+  fi
+  sleep 240
+done
