@@ -156,3 +156,41 @@ def test_trainer_config_accepts_scheduler_specs(tmp_path):
             momentum_scheduler={"type": "inverted_triangular"},
         )
         assert cfg.learning_rate_scheduler["type"] == "cosine_with_warmup"
+
+
+def test_memory_trainer_trains_with_scheduler_slots(tmp_path):
+    """End-to-end: a MemoryTrainer configured with a cosine LR schedule +
+    inverted-triangular momentum actually steps and moves params."""
+    import numpy as np
+
+    from memvul_tpu.build import build_model, build_reader, build_tokenizer, init_params
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+    ws = build_workspace(tmp_path, seed=41)
+    tokenizer = build_tokenizer({"tokenizer_path": ws["paths"]["tokenizer"]})
+    reader = build_reader({
+        "type": "reader_memory", "sample_neg": 1.0,
+        "same_diff_ratio": {"same": 2, "diff": 2},
+        "cve_path": ws["paths"]["cve"], "anchor_path": ws["paths"]["anchors"],
+    })
+    model = build_model(
+        {"type": "model_memory", "encoder": {"preset": "tiny", "vocab_size": 4096},
+         "header_dim": 16}, tokenizer.vocab_size,
+    )
+    trainer = MemoryTrainer(
+        model, init_params(model), tokenizer, reader,
+        train_path=ws["paths"]["train"],
+        config=TrainerConfig(
+            num_epochs=1, batch_size=4, grad_accum=2, max_length=32,
+            steps_per_epoch=3, warmup_steps=1,
+            learning_rate_scheduler={"type": "cosine_with_warmup",
+                                     "warmup_steps": 1, "total_steps": 6},
+            momentum_scheduler={"type": "inverted_triangular",
+                                "cooldown_steps": 2, "warmup_steps": 2},
+        ),
+    )
+    before = np.asarray(trainer.params["params"]["pair_kernel"]).copy()
+    trainer.train_epoch()
+    after = np.asarray(trainer.params["params"]["pair_kernel"])
+    assert np.abs(after - before).max() > 0
