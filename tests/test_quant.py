@@ -151,3 +151,31 @@ def test_mlm_trainer_rejects_quant_config(tmp_path):
     ws = build_workspace(tmp_path, seed=5)
     with pytest.raises(ValueError, match="inference-only"):
         MLMTrainer(QCFG, ws["tokenizer"], MLMTrainerConfig())
+
+
+def test_quant_scoring_sharded_equals_unsharded():
+    """The int8 forward composes with the data-parallel mesh: per-row
+    activation scales are local to each shard, so sharded and unsharded
+    scoring must agree bit-for-bit at f32 accumulation."""
+    from memvul_tpu.models import best_anchor_score
+    from memvul_tpu.parallel import create_mesh, replicate, shard_batch
+
+    rng = np.random.default_rng(6)
+    q_model = MemoryModel(QCFG)
+    ids = jnp.asarray(rng.integers(4, 500, (16, 24)), jnp.int32)
+    batch = {"input_ids": ids, "attention_mask": jnp.ones_like(ids)}
+    params = q_model.init(jax.random.PRNGKey(0), batch, batch)
+    anchors = jnp.asarray(rng.normal(size=(5, 512)), jnp.float32)  # header dim
+
+    @jax.jit
+    def score(p, b, anc):
+        return best_anchor_score(q_model.apply(p, b, anchors=anc))[0]
+
+    ref = score(params, batch, anchors)
+    mesh = create_mesh()
+    sharded = score(
+        replicate(params, mesh), shard_batch(batch, mesh), replicate(anchors, mesh)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
