@@ -153,3 +153,45 @@ def test_quantdrift_tiny_cpu(tmp_path, monkeypatch):
         for l in (tmp_path / "proofs.json").read_text().splitlines()
     ]
     assert rows[-1]["kind"] == "int8_score_drift"
+
+
+def test_analyze_sweep_ranks_and_decides(tmp_path, monkeypatch, capsys):
+    import analyze_sweep
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    (logs / "bench_default.out").write_text(
+        '{"metric": "siamese_scoring_throughput", "value": 2337.1, '
+        '"unit": "reports/sec", "vs_baseline": 12.3}\n'
+    )
+    (logs / "bench_auto6.out").write_text(
+        'auto buckets: (48, 96)\n'
+        '{"metric": "siamese_scoring_throughput", "value": 2400.5, '
+        '"unit": "reports/sec", "vs_baseline": 12.63}\n'
+    )
+    (logs / "bench_flash.out").write_text("crashed before JSON\n")
+    proofs = [
+        {"kind": "flash_parity_timing", "rows": [
+            {"seq_len": 256, "speedup_vs_xla": 0.8},
+            {"seq_len": 512, "speedup_vs_xla": 1.1},
+            {"seq_len": 4096, "speedup_vs_xla": 2.5},
+        ]},
+        {"kind": "int8_score_drift", "max_abs_dp": 0.01, "flip_rate": 0.001},
+        {"kind": "train_ab_base_geometry", "rows": [
+            {"variant": "base", "steady_step_mean_s": 0.477},
+            {"variant": "noremat", "steady_step_mean_s": 0.35},
+            {"variant": "oom", "error": "RESOURCE_EXHAUSTED"},
+        ]},
+    ]
+    (tmp_path / "TPU_PROOFS.json").write_text(
+        "\n".join(json.dumps(r) for r in proofs)
+    )
+    monkeypatch.setattr(analyze_sweep, "REPO", tmp_path)
+    assert analyze_sweep.main(["logs"]) == 0
+    out = capsys.readouterr().out
+    assert "best: bench_auto6" in out
+    assert "FAILED" in out  # the crashed step is visible, not silent
+    assert "keep xla at workload lengths" in out  # 256 lost its A/B
+    assert "int8 default is defensible" in out
+    assert "train A/B best: noremat at 350 ms/step" in out
+    assert analyze_sweep.main(["nope"]) == 1
