@@ -514,3 +514,52 @@ def test_auto_buckets_respects_bucket_budget_including_cap():
     # sample reaching the cap: all four buckets available to the DP
     b2 = auto_buckets(lengths + [512] * 10, max_length=512, n_buckets=4)
     assert len(b2) <= 4 and b2[-1] == 512
+
+
+def test_bucketed_batches_partition_property():
+    """Property (hypothesis): for arbitrary token-length streams, bucketed
+    batching is a PARTITION — every instance appears in exactly one batch
+    row, each row sits in the smallest covering bucket, and every batch
+    has its bucket's fixed shape (the static-shape contract XLA needs)."""
+    from hypothesis import given, settings, strategies as st
+
+    from memvul_tpu.data.batching import bucketed_batches_from_instances
+
+    class StubEncoder:
+        pad_id = 0
+        max_length = 64
+
+        def __call__(self, text):
+            return [1] * int(text)  # text encodes its own token length
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        st.lists(st.integers(min_value=1, max_value=64), max_size=40),
+        st.integers(min_value=1, max_value=5),
+    )
+    def check(lengths, batch_size):
+        instances = [
+            {"text1": str(n), "label": "same",
+             "meta": {"Issue_Url": f"u{i}"}}
+            for i, n in enumerate(lengths)
+        ]
+        buckets = (8, 16, 64)
+        seen = []
+        for batch in bucketed_batches_from_instances(
+            iter(instances), StubEncoder(), batch_size, buckets=buckets
+        ):
+            ids = batch["sample1"]["input_ids"]
+            mask = batch["sample1"]["attention_mask"]
+            width = ids.shape[1]
+            assert width in buckets
+            assert ids.shape[0] == batch_size  # fixed rows (dead-row padded)
+            for row, meta in enumerate(batch["meta"]):
+                n = int(meta["Issue_Url"][1:])
+                seen.append(n)
+                true_len = min(lengths[n], 64)
+                # smallest covering bucket
+                assert width == next(b for b in buckets if b >= true_len)
+                assert int(mask[row].sum()) == true_len
+        assert sorted(seen) == list(range(len(lengths)))
+
+    check()
