@@ -179,3 +179,32 @@ def test_quant_scoring_sharded_equals_unsharded():
     np.testing.assert_allclose(
         np.asarray(sharded), np.asarray(ref), rtol=1e-5, atol=1e-5
     )
+
+
+def test_int8_matmul_error_bound_property():
+    """Property (hypothesis): the dynamic-int8 matmul error stays within
+    the analytic bound K * s_x * s_w (one half-step of each scale per
+    contraction term, doubled for slack) for arbitrary shapes/values."""
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        st.integers(min_value=1, max_value=6),   # rows
+        st.integers(min_value=1, max_value=48),  # K
+        st.integers(min_value=1, max_value=8),   # N
+        st.integers(min_value=0, max_value=2**31 - 1),
+        st.floats(min_value=0.01, max_value=100.0),  # magnitude spread
+    )
+    def check(m, k, n, seed, scale):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.normal(size=(m, k)) * scale, jnp.float32)
+        w = jnp.asarray(rng.normal(size=(k, n)), jnp.float32)
+        exact = np.asarray(x @ w)
+        approx = np.asarray(int8_matmul(x, w))
+        sx = np.abs(np.asarray(x)).max(axis=1, keepdims=True) / 127  # [m,1]
+        sw = np.abs(np.asarray(w)).max(axis=0, keepdims=True) / 127  # [1,n]
+        bound = k * (sx * np.abs(np.asarray(w)).max(axis=0) +
+                     sw * np.abs(np.asarray(x)).max(axis=1, keepdims=True)) + 1e-5
+        assert (np.abs(approx - exact) <= bound).all()
+
+    check()
