@@ -229,6 +229,18 @@ def _run_bench() -> None:
                 "unit": "reports/sec",
                 "vs_baseline": round(rps / baseline, 2),
                 "vs_baseline_band": [round(lo, 2), round(hi, 2)],
+                # self-describing: which knobs produced this number, so a
+                # sweep's artifacts can't be cross-compared blind
+                "config": {
+                    "model": os.environ.get("BENCH_MODEL", "base"),
+                    "seq_len": seq_len,
+                    "buckets": list(buckets) if buckets else None,
+                    "tokens_per_batch": tokens_per_batch,
+                    "reports": n_reports,
+                    "attention": attn,
+                    "quant": quant,
+                    "inflight": inflight,
+                },
             }
         )
     )
