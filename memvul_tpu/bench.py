@@ -28,7 +28,7 @@ default "64,128,256,512"; "auto" = padding-minimizing DP boundaries from
 a corpus length sample, BENCH_BUCKET_COUNT of them, default 6; empty
 string = pad-everything-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
-BENCH_REPORTS (default 16384), BENCH_ATTENTION (xla | flash, default xla),
+BENCH_REPORTS (default 32768), BENCH_ATTENTION (xla | flash, default xla),
 BENCH_QUANT (int8_dynamic — route dense contractions through the MXU's
 int8 path; same params, numerics bounded by the quantdrift proof),
 BENCH_MODEL (base | tiny — tiny is plumbing-validation only),
