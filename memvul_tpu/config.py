@@ -55,7 +55,10 @@ def _split_strings(text: str) -> list:
 
 
 _LOCAL_RE = re.compile(r"\s*local\s+([A-Za-z_]\w*)\s*=")
-_IDENT_RE = re.compile(r"[A-Za-z_]\w*")
+# the lookbehind keeps substitution off identifier-looking tails of
+# numeric literals: with a local named ``e5``, the body literal ``1e5``
+# must stay a number, not become ``1<value>``
+_IDENT_RE = re.compile(r"(?<![\w.])[A-Za-z_]\w*")
 _TRAILING_COMMA_RE = re.compile(r",(?=\s*[}\]])")
 _JSON_WORDS = frozenset({"true", "false", "null"})
 

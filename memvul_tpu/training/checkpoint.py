@@ -11,6 +11,7 @@ reference (model_memory.py:76-77, predict_memory.py:78-83).
 from __future__ import annotations
 
 import json
+import shutil
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple, Union
 
@@ -64,12 +65,16 @@ class TrainCheckpointer:
             # committed best on disk under ``best``, ``best_tmp`` or
             # ``best_old``, and ``_recover_best`` promotes the newest (the
             # epoch save above stays async; best epochs are the minority)
-            import shutil
-
             tmp = self.directory / "best_tmp"
             old = self.directory / "best_old"
             self._recover_best()
-            for stale in (tmp, old):
+            # glob, not exact paths: a crash mid-write leaves orbax
+            # staging litter (best_tmp.orbax-checkpoint-tmp-*) beside the
+            # exact names
+            for stale in (
+                *self.directory.glob("best_tmp*"),
+                *self.directory.glob("best_old*"),
+            ):
                 if stale.exists():
                     shutil.rmtree(stale)
             self._best_ckptr.save(tmp, state)
@@ -85,16 +90,21 @@ class TrainCheckpointer:
 
         Orbax finalizes a save by atomically renaming its own staging dir
         into the target, so an existing ``best_tmp`` is always a fully
-        committed (and newer) checkpoint — prefer it over ``best_old``;
-        a half-written save only ever leaves ``best_tmp.orbax-*`` litter,
-        which the stale cleanup in save() removes."""
-        if self._best_dir.exists():
-            return
+        committed checkpoint that is NEWER than any ``best`` beside it
+        (the swap writes ``best_tmp`` before touching ``best``) — promote
+        it even when ``best`` exists, which covers the crash window after
+        ``best_tmp`` commits but before the old best is renamed aside.
+        ``best_old`` is only ever the pre-swap copy, so it is promoted
+        only when ``best`` is missing.  A half-written save only ever
+        leaves ``best_tmp.orbax-*`` litter, which the glob cleanup in
+        save() removes."""
         tmp = self.directory / "best_tmp"
         old = self.directory / "best_old"
         if tmp.exists():
+            if self._best_dir.exists():
+                shutil.rmtree(self._best_dir)
             tmp.rename(self._best_dir)
-        elif old.exists():
+        elif not self._best_dir.exists() and old.exists():
             old.rename(self._best_dir)
 
     def flush(self) -> None:

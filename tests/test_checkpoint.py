@@ -66,6 +66,43 @@ def test_best_swap_crash_window_prefers_committed_tmp(tmp_path):
     ck.close()
 
 
+def test_best_swap_crash_window_tmp_beside_best_prefers_tmp(tmp_path):
+    """Crash after ``best_tmp`` committed but BEFORE the old best was
+    renamed aside: both ``best`` and ``best_tmp`` exist.  best_tmp is the
+    newer committed copy (the swap writes it first), and the epoch
+    checkpoint's MetricTracker already records the newer epoch as best —
+    recovery must promote best_tmp over the stale best (round-4 advisor)."""
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck.save(0, _state(1.0), is_best=True)
+    ck.flush()
+    base = tmp_path / "ck"
+    ck._best_ckptr.save(base / "best_tmp", _state(9.0))  # newer, committed
+    ck._best_ckptr.wait_until_finished()
+    restored = ck.restore_best(_state(0.0))
+    ck.close()
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 9.0))
+    assert not (base / "best_tmp").exists()
+
+
+def test_best_save_cleans_orbax_staging_litter(tmp_path):
+    """A crash mid-write leaves orbax staging dirs beside the exact
+    ``best_tmp`` name (``best_tmp.orbax-checkpoint-tmp-*``); the next
+    best save must glob them away, not just the exact paths
+    (round-4 advisor)."""
+    ck = TrainCheckpointer(tmp_path / "ck")
+    litter = tmp_path / "ck" / "best_tmp.orbax-checkpoint-tmp-1234"
+    litter.mkdir(parents=True)
+    (litter / "partial").write_text("half-written")
+    ck.save(0, _state(2.0), is_best=True)
+    ck.flush()
+    assert not litter.exists()
+    restored = ck.restore_best(_state(0.0))
+    ck.close()
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], np.full((4,), 2.0))
+
+
 def test_first_best_save_crash_leaves_only_tmp(tmp_path):
     """Crash after the very first best save committed ``best_tmp`` but
     before any rename: restore_best must still find it."""

@@ -208,6 +208,14 @@ def test_jsonnet_identifier_not_substituted_inside_strings():
     assert cfg == {"note": "seed stays literal", "s": 7}
 
 
+def test_jsonnet_local_does_not_corrupt_exponent_literals():
+    """A local named like an exponent tail (``e5``) must not be
+    substituted inside numeric literals: ``1e5`` stays 100000.0, and the
+    bare reference still resolves (round-4 advisor)."""
+    cfg = loads_config('local e5 = 3;\n{"big": 1e5, "neg": 2.5e5, "ref": e5}')
+    assert cfg == {"big": 1e5, "neg": 2.5e5, "ref": 3}
+
+
 def test_reference_config_files_parse_verbatim():
     """The reference's own Jsonnet configs load without modification
     (the last ergonomic gap in the drop-in config shape)."""

@@ -27,6 +27,18 @@ step() { # step <name> <timeout_s> <cmd...>  (resumable: skips on .done)
     echo "=== $name already done — skipping ==="
     return 0
   fi
+  # DEADLINE_EPOCH: never let a step outlive the round's tunnel hand-off
+  # point (the driver's round-end bench needs exclusive tunnel access —
+  # two clients wedge it). Shrink the step timeout to what's left; skip
+  # entirely if <120s remain.
+  if [ -n "${DEADLINE_EPOCH:-}" ]; then
+    local now rem; now=$(date +%s); rem=$(( DEADLINE_EPOCH - now ))
+    if [ "$rem" -lt 120 ]; then
+      echo "DEADLINE reached before $name — stopping agenda" | tee "$LOG/DEADLINE_STOP"
+      exit 4
+    fi
+    if [ "$rem" -lt "$tmo" ]; then tmo=$rem; fi
+  fi
   echo "=== $name ($(date +%H:%M:%S)) ==="
   if ! probe; then
     echo "TUNNEL DEAD before $name — aborting remaining steps" | tee "$LOG/ABORTED"
@@ -47,7 +59,7 @@ step() { # step <name> <timeout_s> <cmd...>  (resumable: skips on .done)
     date > "$LOG/$name.done"
   fi
 }
-rm -f "$LOG/ABORTED"
+rm -f "$LOG/ABORTED" "$LOG/DEADLINE_STOP"
 
 # 1. the headline number, default config (matches what the driver runs)
 step bench_default 2400 env BENCH_DEVICE_WAIT=60 python bench.py

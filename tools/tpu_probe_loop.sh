@@ -1,11 +1,25 @@
 #!/bin/bash
 # Probe the axon tunnel every 4 min; while it answers, run the (resumable)
-# round-4 on-chip agenda in the foreground; if the agenda aborts on a
-# re-wedge, go back to probing.  Exits only when the agenda completes.
+# on-chip agenda in the foreground; if the agenda aborts on a re-wedge, go
+# back to probing.  Exits only when the agenda completes or the round's
+# tunnel hand-off point passes (the driver's round-end bench must have
+# exclusive tunnel access — two clients wedge it).
+#
+# Round-5 clock: round started ~03:35 UTC, ends ~15:35 UTC. Agenda work
+# stops at CUTOFF so the tunnel is free well before the driver bench.
 cd /root/repo
 LOG=/root/repo/.tpu_probe/probe.log
+CUTOFF_EPOCH=$(date -d "14:50" +%s)
+export DEADLINE_EPOCH=$CUTOFF_EPOCH
 while true; do
   TS=$(date +%H:%M:%S)
+  # cutoff check BEFORE probing: past the hand-off point even the 75s
+  # probe would be a second concurrent tunnel client against the
+  # driver's round-end bench — the exact two-client wedge condition
+  if [ "$(date +%s)" -ge "$CUTOFF_EPOCH" ]; then
+    echo "$TS past agenda cutoff — standing down without probing" >> "$LOG"
+    exit 0
+  fi
   OUT=$(timeout 75 python - <<'PY' 2>&1
 import jax, jax.numpy as jnp
 x = jnp.ones((128,128))
@@ -13,17 +27,6 @@ print("SUM", float((x@x).sum()))
 PY
 )
   RC=$?
-  # after 01:30 the driver's round-end bench may start at any moment —
-  # never hold the tunnel with a long agenda then (two clients wedge it);
-  # just record liveness and stand down
-  H=$(date +%H) ; M=$(date +%M)
-  if [ "$H" -ge 2 ] && [ "$H" -lt 14 ] || { [ "$H" -eq 1 ] && [ "$M" -ge 30 ]; }; then
-    if [ $RC -eq 0 ] && echo "$OUT" | grep -q "SUM"; then
-      echo "$TS ALIVE but past agenda cutoff — standing down" >> "$LOG"
-      date > /root/repo/.tpu_probe/ALIVE
-    fi
-    exit 0
-  fi
   if [ $RC -eq 0 ] && echo "$OUT" | grep -q "SUM"; then
     echo "$TS ALIVE — running round4_onchip.sh" >> "$LOG"
     date > /root/repo/.tpu_probe/ALIVE
