@@ -232,6 +232,33 @@ def cmd_analyze(args) -> int:
     return 0
 
 
+def cmd_parity(args) -> int:
+    """One-command real-weights F1-parity chain (evaluate/parity.py):
+    convert parity at checkpoint geometry, reference-archive scoring,
+    metric diff vs the reference pipeline's own metric file."""
+    from .evaluate.parity import run_parity
+
+    try:
+        report = run_parity(
+            args.hf_dir,
+            archive=args.archive,
+            corpus=args.corpus,
+            anchors=args.anchors,
+            ref_metrics=args.ref_metrics,
+            out_dir=args.out_dir,
+            max_length=args.max_length,
+            batch_size=args.batch_size,
+            thres=args.threshold,
+            atol=args.atol,
+            seq_len=args.seq_len,
+        )
+    except ValueError as e:
+        print(f"parity: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2, default=float))
+    return 0 if report["ok"] else 1
+
+
 def cmd_bench(args) -> int:
     from .bench import main as bench_main
 
@@ -352,6 +379,32 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bench", help="run the throughput benchmark")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "parity",
+        help="real-weights parity chain: HF convert check, reference-"
+        "archive scoring, metric diff (run on a machine that has the "
+        "genuine bert-base-uncased dir / reference model.tar.gz)",
+    )
+    p.add_argument("--hf-dir", required=True,
+                   help="local HF checkpoint dir (config.json + torch "
+                   "weights + vocab.txt), e.g. bert-base-uncased")
+    p.add_argument("--archive", default=None,
+                   help="reference-trained model.tar.gz")
+    p.add_argument("--corpus", default=None, help="test_project.json")
+    p.add_argument("--anchors", default=None,
+                   help="CWE_anchor_golden_project.json")
+    p.add_argument("--ref-metrics", default=None,
+                   help="metric file the reference pipeline wrote, to diff")
+    p.add_argument("-o", "--out-dir", default="parity_out")
+    p.add_argument("--max-length", type=int, default=512)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--atol", type=float, default=5e-4,
+                   help="convert-parity max-abs-error acceptance")
+    p.add_argument("--seq-len", type=int, default=128,
+                   help="convert-parity probe sequence length")
+    p.set_defaults(fn=cmd_parity)
 
     p = sub.add_parser(
         "selfcheck",

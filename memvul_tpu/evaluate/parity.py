@@ -1,0 +1,278 @@
+"""One-command real-weights F1-parity runner.
+
+The ±0.5-F1 acceptance (BASELINE.md) needs the genuine bert-base-uncased
+checkpoint and, ideally, a reference-trained ``model.tar.gz``
+(reference: predict_memory.py:62-67) — artifacts a zero-egress
+environment cannot fetch.  This module packages the whole chain so that
+anyone with network access runs it as ONE command:
+
+    python -m memvul_tpu parity --hf-dir /path/to/bert-base-uncased \\
+        [--archive model.tar.gz --corpus test_project.json \\
+         --anchors CWE_anchor_golden_project.json] \\
+        [--ref-metrics reference_metric.json] [-o parity_out/]
+
+Stages (each skipped cleanly when its inputs are absent):
+
+(a) **convert parity** — HF torch ``BertModel`` forward vs the in-repo
+    Flax encoder through :mod:`memvul_tpu.models.convert`, at the
+    checkpoint's own geometry, on random inputs; reports the max
+    absolute/relative hidden-state error (the logit-level oracle of
+    tests/test_convert_parity.py, at real scale).
+(b) **archive scoring** — load the reference archive
+    (:mod:`memvul_tpu.evaluate.reference_archive`), tokenize with the
+    checkpoint's own ``vocab.txt`` (id-level parity-tested vs HF's
+    BertTokenizer), and run the full streaming eval
+    (reference: predict_memory.py:49-114) over ``--corpus``, writing the
+    reference-format result and metric files.
+(c) **metric diff** — compare (b)'s metrics against a metric file the
+    reference pipeline produced (``--ref-metrics``), flagging any
+    divergence beyond the acceptance band.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+
+from ..models.bert import BertConfig, BertEncoder
+
+# max-over-anchors F1 acceptance band, in absolute F1 points (BASELINE.md)
+F1_TOLERANCE = 0.005
+
+
+def hf_geometry(hf_dir: Union[str, Path]) -> BertConfig:
+    """Encoder geometry from an HF checkpoint dir's ``config.json``."""
+    cfg = json.loads((Path(hf_dir) / "config.json").read_text())
+    return BertConfig(
+        vocab_size=cfg["vocab_size"],
+        hidden_size=cfg["hidden_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        max_position_embeddings=cfg.get("max_position_embeddings", 512),
+        type_vocab_size=cfg.get("type_vocab_size", 2),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+    )
+
+
+def convert_logit_parity(
+    hf_dir: Union[str, Path],
+    batch: int = 4,
+    seq_len: int = 128,
+    seed: int = 0,
+    atol: float = 5e-4,
+) -> Dict[str, Any]:
+    """Stage (a): torch-vs-Flax hidden-state parity at checkpoint geometry.
+
+    Loads the torch weights from ``hf_dir`` (``from_pretrained`` on a
+    local directory — no network), converts them, and compares the final
+    hidden states on random unmasked-and-masked inputs.  fp32 both sides;
+    errors come only from op-order differences, so they stay near machine
+    epsilon per layer and accumulate with depth — ``atol`` defaults to a
+    band that 12-layer bert-base clears by an order of magnitude.
+    """
+    import torch
+    import transformers
+
+    from ..models.convert import convert_bert_state_dict
+
+    config = hf_geometry(hf_dir)
+    model = transformers.BertModel.from_pretrained(
+        str(hf_dir), local_files_only=True
+    ).eval()
+
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(
+        1, config.vocab_size, size=(batch, seq_len)
+    ).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[batch // 2 :, seq_len // 2 :] = 0  # exercise padding handling too
+
+    with torch.no_grad():
+        theirs = model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+        ).last_hidden_state.numpy()
+
+    bert_subtree, _ = convert_bert_state_dict(model.state_dict(), config)
+    ours = np.asarray(
+        BertEncoder(config).apply({"params": bert_subtree}, ids, mask)
+    )
+
+    real = mask.astype(bool)  # masked positions are junk on both sides
+    diff = np.abs(ours[real] - theirs[real])
+    denom = np.maximum(np.abs(theirs[real]), 1e-6)
+    result = {
+        "geometry": {
+            "hidden_size": config.hidden_size,
+            "num_layers": config.num_layers,
+            "num_heads": config.num_heads,
+            "vocab_size": config.vocab_size,
+        },
+        "batch": batch,
+        "seq_len": seq_len,
+        "max_abs_err": float(diff.max()),
+        "mean_abs_err": float(diff.mean()),
+        "max_rel_err": float((diff / denom).max()),
+        "atol": atol,
+        "ok": bool(diff.max() <= atol),
+    }
+    return result
+
+
+def archive_scoring(
+    archive: Union[str, Path],
+    hf_dir: Union[str, Path],
+    corpus: Union[str, Path],
+    anchors: Union[str, Path],
+    out_dir: Union[str, Path],
+    max_length: int = 512,
+    batch_size: int = 512,
+    thres: float = 0.5,
+) -> Dict[str, Any]:
+    """Stage (b): score ``corpus`` with the reference-trained archive.
+
+    Geometry comes from the HF checkpoint dir (the archive's config names
+    an HF model rather than carrying dims, reference_archive.py), the
+    vocabulary from its ``vocab.txt`` (precedence documented in
+    data/tokenizer.py — the genuine file gives reference tokenization
+    exactly).  Output files follow the reference's result/metric format
+    byte-for-byte key-wise (evaluate/measure.py).
+    """
+    from ..data.readers import MemoryReader
+    from ..data.tokenizer import WordPieceTokenizer
+    from .predict_memory import test_siamese
+    from .reference_archive import load_reference_archive
+
+    vocab = Path(hf_dir) / "vocab.txt"
+    if not vocab.exists():
+        raise FileNotFoundError(
+            f"{vocab} missing — archive scoring needs the checkpoint's own "
+            "vocabulary for reference-exact tokenization"
+        )
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    config = hf_geometry(hf_dir)
+    model, params, stored = load_reference_archive(archive, config)
+    tokenizer = WordPieceTokenizer(vocab_path=vocab)
+    metrics = test_siamese(
+        model,
+        params,
+        tokenizer,
+        test_file=corpus,
+        golden_file=anchors,
+        out_results=out / "parity_result.json",
+        out_metrics=out / "parity_metric.json",
+        reader=MemoryReader(anchor_path=str(anchors)),
+        use_mesh=False,
+        batch_size=batch_size,
+        max_length=max_length,
+        thres=thres,
+    )
+    return {
+        "archive_config_model": (stored.get("model") or {}).get("type"),
+        "result_file": str(out / "parity_result.json"),
+        "metric_file": str(out / "parity_metric.json"),
+        "metrics": metrics,
+    }
+
+
+def metric_diff(
+    ours: Dict[str, float],
+    ref_metrics_path: Union[str, Path],
+    f1_tolerance: float = F1_TOLERANCE,
+) -> Dict[str, Any]:
+    """Stage (c): ours vs a reference-produced metric file.
+
+    Compares every shared numeric key; the accept/reject verdict hangs on
+    f1 alone (the BASELINE.md criterion)."""
+    theirs = json.loads(Path(ref_metrics_path).read_text())
+    deltas = {}
+    for key, ref_val in theirs.items():
+        if isinstance(ref_val, (int, float)) and key in ours:
+            deltas[key] = {
+                "ours": float(ours[key]),
+                "reference": float(ref_val),
+                "delta": float(ours[key]) - float(ref_val),
+            }
+    f1_delta = deltas.get("f1", {}).get("delta")
+    return {
+        "deltas": deltas,
+        "f1_delta": f1_delta,
+        "f1_tolerance": f1_tolerance,
+        "ok": f1_delta is not None and abs(f1_delta) <= f1_tolerance,
+    }
+
+
+def run_parity(
+    hf_dir: Union[str, Path],
+    archive: Optional[Union[str, Path]] = None,
+    corpus: Optional[Union[str, Path]] = None,
+    anchors: Optional[Union[str, Path]] = None,
+    ref_metrics: Optional[Union[str, Path]] = None,
+    out_dir: Union[str, Path] = "parity_out",
+    max_length: int = 512,
+    batch_size: int = 512,
+    thres: float = 0.5,
+    atol: float = 5e-4,
+    seq_len: int = 128,
+) -> Dict[str, Any]:
+    """Run every stage whose inputs are present.  A stage not run appears
+    in the report as ``{"skipped": true, "reason": ...}`` (shape-stable
+    for programmatic consumers); PARTIALLY supplied stage inputs are an
+    error, not a skip — an acceptance run that quietly dropped its
+    scoring stage must never read as a pass."""
+    scoring_inputs = {"--archive": archive, "--corpus": corpus,
+                      "--anchors": anchors}
+    supplied = [k for k, v in scoring_inputs.items() if v]
+    missing = [k for k, v in scoring_inputs.items() if not v]
+    if supplied and missing:
+        raise ValueError(
+            f"archive scoring needs {', '.join(missing)} too "
+            f"(got only {', '.join(supplied)})"
+        )
+    if ref_metrics and missing:
+        raise ValueError(
+            "--ref-metrics diffs the archive-scoring metrics — supply "
+            "--archive/--corpus/--anchors as well"
+        )
+
+    report: Dict[str, Any] = {
+        "convert_parity": convert_logit_parity(
+            hf_dir, seq_len=seq_len, atol=atol
+        )
+    }
+    ok = report["convert_parity"]["ok"]
+
+    if not missing:
+        report["archive_scoring"] = archive_scoring(
+            archive, hf_dir, corpus, anchors, out_dir,
+            max_length=max_length, batch_size=batch_size, thres=thres,
+        )
+        if ref_metrics:
+            report["metric_diff"] = metric_diff(
+                report["archive_scoring"]["metrics"], ref_metrics
+            )
+            ok = ok and report["metric_diff"]["ok"]
+        else:
+            report["metric_diff"] = {
+                "skipped": True,
+                "reason": "pass --ref-metrics <reference metric.json> to "
+                "diff against the reference pipeline's own numbers",
+            }
+    else:
+        report["archive_scoring"] = {
+            "skipped": True,
+            "reason": "pass --archive model.tar.gz --corpus test.json "
+            "--anchors golden.json to score a reference-trained checkpoint",
+        }
+        report["metric_diff"] = {
+            "skipped": True,
+            "reason": "needs archive scoring first",
+        }
+    report["ok"] = ok
+    return report

@@ -1,0 +1,143 @@
+"""The one-command parity runner (evaluate/parity.py + ``parity`` CLI).
+
+True numeric parity vs torch is pinned by tests/test_convert_parity.py
+and tests/test_reference_archive_parity.py; these tests pin the
+PACKAGING — that a single command drives convert-check → archive
+scoring → metric diff end-to-end on a synthetic HF dir + reference
+archive, so the real-weights run on a networked machine is pure
+execution (round-4 verdict #4)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import test_reference_archive_parity as refarc
+
+from memvul_tpu.__main__ import main as cli_main
+from memvul_tpu.data.synthetic import build_workspace, corpus_texts, generate_corpus
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+from memvul_tpu.evaluate.parity import convert_logit_parity, hf_geometry
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("paritycli"), seed=33)
+
+
+@pytest.fixture(scope="module")
+def torch_model_and_hf_dir(tmp_path_factory):
+    """A reference-shaped torch model plus an HF checkpoint dir saved from
+    its OWN transformer, so stage (a) compares those exact weights and
+    stage (b) reads matching geometry + vocabulary."""
+    reports, _ = generate_corpus(seed=33)
+    tok = WordPieceTokenizer.train_from_corpus(
+        corpus_texts(reports), vocab_size=1024
+    )
+    torch.manual_seed(7)
+    model = refarc.TorchMemoryModel(vocab_size=tok.vocab_size)
+    model.eval()
+
+    hf_dir = tmp_path_factory.mktemp("hf") / "tiny-bert"
+    bert = model._text_field_embedder.token_embedder_tokens.transformer_model
+    bert.save_pretrained(str(hf_dir))
+    vocab = sorted(tok._tok.get_vocab().items(), key=lambda kv: kv[1])
+    (hf_dir / "vocab.txt").write_text("\n".join(w for w, _ in vocab) + "\n")
+    return model, hf_dir
+
+
+def test_hf_geometry_reads_checkpoint_dims(torch_model_and_hf_dir):
+    _, hf_dir = torch_model_and_hf_dir
+    cfg = hf_geometry(hf_dir)
+    assert cfg.hidden_size == refarc.HIDDEN
+    assert cfg.num_layers == refarc.LAYERS
+    assert cfg.num_heads == refarc.HEADS
+    assert cfg.intermediate_size == refarc.INTER
+
+
+def test_convert_logit_parity_stage(torch_model_and_hf_dir):
+    _, hf_dir = torch_model_and_hf_dir
+    report = convert_logit_parity(hf_dir, batch=2, seq_len=24, atol=1e-3)
+    assert report["ok"], report
+    assert report["max_abs_err"] < 1e-3
+    assert report["geometry"]["num_layers"] == refarc.LAYERS
+
+
+def test_parity_cli_full_chain(torch_model_and_hf_dir, ws, tmp_path, capsys):
+    model, hf_dir = torch_model_and_hf_dir
+    archive = refarc._save_reference_archive(model, tmp_path / "model.tar.gz")
+    out = tmp_path / "parity_out"
+
+    base_args = [
+        "parity", "--hf-dir", str(hf_dir),
+        "--archive", str(archive),
+        "--corpus", ws["paths"]["test"],
+        "--anchors", ws["paths"]["anchors"],
+        "-o", str(out),
+        "--max-length", "64", "--batch-size", "16",
+        "--seq-len", "24", "--atol", "1e-3",
+    ]
+    rc = cli_main(base_args)
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert report["convert_parity"]["ok"]
+    assert "f1" in report["archive_scoring"]["metrics"]
+    assert Path(report["archive_scoring"]["result_file"]).exists()
+    metric_file = Path(report["archive_scoring"]["metric_file"])
+    assert metric_file.exists()
+    assert report["metric_diff"]["skipped"] is True
+
+    # a matching reference metric file diffs clean …
+    rc = cli_main(base_args + ["--ref-metrics", str(metric_file)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    assert report["metric_diff"]["ok"]
+    assert report["metric_diff"]["deltas"]["f1"]["delta"] == 0.0
+
+    # … and one outside the ±0.5-F1 band fails the run
+    drifted = json.loads(metric_file.read_text())
+    drifted["f1"] = float(drifted["f1"]) + 0.1
+    bad = tmp_path / "ref_metric_drifted.json"
+    bad.write_text(json.dumps(drifted))
+    rc = cli_main(base_args + ["--ref-metrics", str(bad)])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert not report["metric_diff"]["ok"]
+
+
+def test_parity_without_archive_reports_skip(torch_model_and_hf_dir, capsys):
+    _, hf_dir = torch_model_and_hf_dir
+    rc = cli_main([
+        "parity", "--hf-dir", str(hf_dir),
+        "--seq-len", "24", "--atol", "1e-3",
+    ])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["archive_scoring"]["skipped"] is True
+    assert report["metric_diff"]["skipped"] is True
+
+
+def test_parity_partial_scoring_inputs_error(torch_model_and_hf_dir, capsys):
+    """Forgetting one of --archive/--corpus/--anchors (or passing
+    --ref-metrics without them) must be a hard error naming the missing
+    flags, never a silent skip that reads as a pass."""
+    _, hf_dir = torch_model_and_hf_dir
+    rc = cli_main([
+        "parity", "--hf-dir", str(hf_dir),
+        "--archive", "whatever.tar.gz",
+        "--corpus", "test.json",
+        "--seq-len", "24",
+    ])
+    assert rc == 2
+    assert "--anchors" in capsys.readouterr().err
+
+    rc = cli_main([
+        "parity", "--hf-dir", str(hf_dir),
+        "--ref-metrics", "ref_metric.json",
+        "--seq-len", "24",
+    ])
+    assert rc == 2
+    assert "--ref-metrics" in capsys.readouterr().err
