@@ -252,7 +252,9 @@ def cmd_parity(args) -> int:
             atol=args.atol,
             seq_len=args.seq_len,
         )
-    except ValueError as e:
+    except (ValueError, FileNotFoundError) as e:
+        # usage / missing-artifact problems exit 2, distinct from exit 1
+        # = "parity ran and failed tolerance"
         print(f"parity: {e}", file=sys.stderr)
         return 2
     print(json.dumps(report, indent=2, default=float))

@@ -136,6 +136,11 @@ def _run_bench() -> None:
         cfg = BertConfig.base(
             vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
         )
+        if seq_len > cfg.max_position_embeddings:
+            # long-context rows (configs/config_memory_longctx.json is the
+            # production shape): extend the position table to the cap —
+            # bench params are random-init, so only the geometry matters
+            cfg = cfg.replace(max_position_embeddings=seq_len)
     attn = os.environ.get("BENCH_ATTENTION", "xla")
     if attn != "xla":
         cfg = cfg.replace(attention_impl=attn)

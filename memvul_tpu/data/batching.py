@@ -46,6 +46,33 @@ class CachedEncoder:
                 self._cache[text] = ids
         return ids
 
+    def encode_many(self, texts: Sequence[str]) -> List[List[int]]:
+        """Batch lookup: cache misses go through the tokenizer's parallel
+        ``encode_many`` in ONE call (rust/rayon threads — the cold-pass
+        scaling path for multi-core hosts), so repeated texts (anchors,
+        CVE descriptions) still hit the memo and only unique misses pay
+        tokenization."""
+        fresh: Dict[str, List[int]] = {}
+        misses = [t for t in dict.fromkeys(texts) if t not in self._cache]
+        if misses:
+            for t, ids in zip(
+                misses,
+                self._tokenizer.encode_many(misses, max_length=self._max_length),
+            ):
+                fresh[t] = ids
+                if len(self._cache) < self._cache_size:
+                    self._cache[t] = ids
+        return [
+            self._cache[t] if t in self._cache else fresh[t] for t in texts
+        ]
+
+
+def _encode_many(encoder, texts: Sequence[str]) -> List[List[int]]:
+    """Batch path when the encoder has one (CachedEncoder → rust thread
+    pool), scalar loop otherwise (duck-typed stub encoders in tests)."""
+    many = getattr(encoder, "encode_many", None)
+    return many(texts) if many is not None else [encoder(t) for t in texts]
+
 
 def _pad_block(
     seqs: Sequence[List[int]],
@@ -87,13 +114,7 @@ def batches_from_instances(
     float32 (0 for padding rows), and ``meta`` (list, real rows only).
     """
     label_map = label_map or LABELS_SIAMESE
-    chunk: List[Dict] = []
-    for inst in instances:
-        chunk.append(inst)
-        if len(chunk) == batch_size:
-            yield _collate(chunk, encoder, batch_size, label_map, buckets, pad_to_max)
-            chunk = []
-    if chunk:
+    for chunk in _blocks(instances, batch_size):
         yield _collate(chunk, encoder, batch_size, label_map, buckets, pad_to_max)
 
 
@@ -105,7 +126,7 @@ def _collate(
     buckets: Optional[Sequence[int]],
     pad_to_max: bool,
 ) -> Dict:
-    seqs1 = [encoder(inst["text1"]) for inst in chunk]
+    seqs1 = _encode_many(encoder, [inst["text1"] for inst in chunk])
     length1 = (
         encoder.max_length
         if pad_to_max
@@ -131,7 +152,7 @@ def _collate(
         "meta": [inst.get("meta", {}) for inst in chunk],
     }
     if chunk and chunk[0].get("text2") is not None:
-        seqs2 = [encoder(inst["text2"]) for inst in chunk]
+        seqs2 = _encode_many(encoder, [inst["text2"] for inst in chunk])
         length2 = (
             encoder.max_length
             if pad_to_max
@@ -173,20 +194,41 @@ def bucketed_batches_from_instances(
     else:
         sizes = {b: int(batch_size) for b in buckets}
     pending: Dict[int, List[Dict]] = {b: [] for b in buckets}
-    for inst in instances:
-        if inst.get("text2") is not None:
-            raise ValueError("bucketed batching supports single-text instances only")
-        seq = encoder(inst["text1"])
-        bucket = next((b for b in buckets if b >= len(seq)), buckets[-1])
-        slot = dict(inst)
-        slot["_ids"] = seq
-        pending[bucket].append(slot)
-        if len(pending[bucket]) == sizes[bucket]:
-            yield _collate_bucket(pending[bucket], encoder, sizes[bucket], label_map, bucket)
-            pending[bucket] = []
+    # tokenize in blocks, not per-instance: one encode_many call hands the
+    # whole block to the rust tokenizer's thread pool (cold-pass host
+    # tokenization is the few-core bottleneck, docs/full_corpus.md)
+    for block in _blocks(instances, 512):
+        texts = []
+        for inst in block:
+            if inst.get("text2") is not None:
+                raise ValueError(
+                    "bucketed batching supports single-text instances only"
+                )
+            texts.append(inst["text1"])
+        for inst, seq in zip(block, _encode_many(encoder, texts)):
+            bucket = next((b for b in buckets if b >= len(seq)), buckets[-1])
+            slot = dict(inst)
+            slot["_ids"] = seq
+            pending[bucket].append(slot)
+            if len(pending[bucket]) == sizes[bucket]:
+                yield _collate_bucket(
+                    pending[bucket], encoder, sizes[bucket], label_map, bucket
+                )
+                pending[bucket] = []
     for bucket in buckets:
         if pending[bucket]:
             yield _collate_bucket(pending[bucket], encoder, sizes[bucket], label_map, bucket)
+
+
+def _blocks(it: Iterable[Dict], size: int) -> Iterator[List[Dict]]:
+    block: List[Dict] = []
+    for x in it:
+        block.append(x)
+        if len(block) == size:
+            yield block
+            block = []
+    if block:
+        yield block
 
 
 def bucket_batch_sizes(

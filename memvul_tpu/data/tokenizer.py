@@ -41,6 +41,14 @@ class TextTokenizer(Registrable):
     def encode(self, text: str, max_length: Optional[int] = None) -> List[int]:
         raise NotImplementedError
 
+    def encode_many(
+        self, texts: Sequence[str], max_length: Optional[int] = None
+    ) -> List[List[int]]:
+        """Batch encode.  Subclasses override when they have a parallel
+        batch path; the contract is exact per-text equality with
+        :meth:`encode`."""
+        return [self.encode(t, max_length=max_length) for t in texts]
+
     @property
     def vocab_size(self) -> int:
         raise NotImplementedError
@@ -65,7 +73,7 @@ class TextTokenizer(Registrable):
         """
         from .batching import _bucket_length, _pad_block
 
-        encoded = [self.encode(t, max_length=max_length) for t in texts]
+        encoded = self.encode_many(texts, max_length=max_length)
         if pad_to is not None:
             length = pad_to
         else:
@@ -165,7 +173,22 @@ class WordPieceTokenizer(TextTokenizer):
     # -- interface -----------------------------------------------------------
 
     def encode(self, text: str, max_length: Optional[int] = None) -> List[int]:
-        ids = self._tok.encode(text).ids
+        return self._frame(self._tok.encode(text).ids, max_length)
+
+    def encode_many(
+        self, texts: Sequence[str], max_length: Optional[int] = None
+    ) -> List[List[int]]:
+        """Parallel batch encode: the rust tokenizer's ``encode_batch``
+        fans work across native threads (rayon, one per core), so the
+        cold-pass host tokenization that caps corpus throughput on
+        few-core rigs (docs/full_corpus.md) scales with the host's core
+        count instead of pinning one Python thread.  Per-text output is
+        byte-identical to :meth:`encode`
+        (tests/test_parallel_tokenize.py)."""
+        encodings = self._tok.encode_batch(list(texts))
+        return [self._frame(e.ids, max_length) for e in encodings]
+
+    def _frame(self, ids: List[int], max_length: Optional[int]) -> List[int]:
         if not ids or ids[0] != self._cls:
             ids = [self._cls] + ids + [self._sep]
         if max_length is not None and len(ids) > max_length:

@@ -93,7 +93,7 @@ class SiamesePredictor:
         for start in range(0, len(instances), self.anchor_chunk):
             chunk = instances[start : start + self.anchor_chunk]
             texts = [inst["text1"] for inst in chunk]
-            seqs = [self.encoder(t) for t in texts]
+            seqs = self.encoder.encode_many(texts)
             ids = np.full(
                 (self.anchor_chunk, self.encoder.max_length),
                 self.encoder.pad_id,

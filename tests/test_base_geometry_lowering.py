@@ -1,0 +1,160 @@
+"""Base/large-geometry lowering on the virtual 8-device mesh.
+
+The multichip dryrun proves sharded semantics at tiny geometry only
+(64-hidden, 2-layer) — shapes there can hide TP-divisibility and layout
+mistakes that bite at real scale (round-4 verdict stretch #7).  These
+tests jit-lower AND compile (SPMD partition — no execution, no weight
+materialization: params are ``ShapeDtypeStruct``s) the fused dp×tp
+train step and the anchor-bank scoring program at bert-base and
+bert-large geometry, so e.g. 16 heads / tp=2 at bert-large is checked by
+the partitioner itself, not just by ``validate_divisibility`` unit
+arithmetic, and the dp/tp collectives are asserted present in the
+compiled HLO.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.models.memory import anchor_probs
+from memvul_tpu.parallel import create_mesh
+from memvul_tpu.parallel.sharding import param_specs, validate_divisibility
+from memvul_tpu.training.optim import make_optimizer
+from memvul_tpu.training.trainer import make_train_step
+
+pytestmark = pytest.mark.slow
+
+DP, TP = 4, 2
+SEQ = 256  # the workload length (reference config_memory.json max_length)
+
+
+def _geometry(name: str) -> BertConfig:
+    make = getattr(BertConfig, name)
+    return make(dtype=jnp.bfloat16, scan_layers=True)
+
+
+def _abstract_params(model):
+    dummy = {
+        "input_ids": jax.ShapeDtypeStruct((2, 8), np.int32),
+        "attention_mask": jax.ShapeDtypeStruct((2, 8), np.int32),
+    }
+    return jax.eval_shape(model.init, jax.random.PRNGKey(0), dummy, dummy)
+
+
+def _with_shardings(abstract, mesh, specs):
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.ShapeDtypeStruct(
+            a.shape, a.dtype, sharding=NamedSharding(mesh, s)
+        ),
+        abstract,
+        specs,
+    )
+
+
+def _concrete_skeleton(abstract):
+    """Minimal concrete tree with the same paths, for optimizer-group
+    label derivation only (shapes are irrelevant to the labels)."""
+    return jax.tree_util.tree_map(
+        lambda a: np.zeros((1,) * a.ndim, np.float32), abstract
+    )
+
+
+@pytest.mark.parametrize("geometry", ["base", "large"])
+def test_dp_tp_train_step_lowers_at_real_geometry(geometry):
+    mesh = create_mesh({"data": DP, "model": TP})
+    cfg = _geometry(geometry)
+    model = MemoryModel(cfg)
+    abstract = _abstract_params(model)
+
+    bad = validate_divisibility(abstract, mesh)
+    assert not bad, f"indivisible TP dims at bert-{geometry}: {bad}"
+
+    specs = param_specs(abstract)
+    params_abs = _with_shardings(abstract, mesh, specs)
+
+    tx, _ = make_optimizer(_concrete_skeleton(abstract), warmup_steps=2)
+    opt_abs = jax.eval_shape(tx.init, abstract)
+
+    K, B = 2, 4 * DP
+    batch_spec = P(None, "data", None)
+    row_spec = P(None, "data")
+    stack = {
+        "sample1": {
+            "input_ids": jax.ShapeDtypeStruct(
+                (K, B, SEQ), np.int32, sharding=NamedSharding(mesh, batch_spec)
+            ),
+            "attention_mask": jax.ShapeDtypeStruct(
+                (K, B, SEQ), np.int32, sharding=NamedSharding(mesh, batch_spec)
+            ),
+        },
+        "sample2": {
+            "input_ids": jax.ShapeDtypeStruct(
+                (K, B, SEQ), np.int32, sharding=NamedSharding(mesh, batch_spec)
+            ),
+            "attention_mask": jax.ShapeDtypeStruct(
+                (K, B, SEQ), np.int32, sharding=NamedSharding(mesh, batch_spec)
+            ),
+        },
+        "label": jax.ShapeDtypeStruct(
+            (K, B), np.int32, sharding=NamedSharding(mesh, row_spec)
+        ),
+        "weight": jax.ShapeDtypeStruct(
+            (K, B), np.float32, sharding=NamedSharding(mesh, row_spec)
+        ),
+    }
+
+    step = make_train_step(model, tx)
+    # lower() already validates argument shardings (indivisible dims fail
+    # here); compile() runs the SPMD partitioner and inserts collectives
+    compiled = jax.jit(step).lower(
+        params_abs, opt_abs, jax.random.PRNGKey(0), stack
+    ).compile()
+    hlo = compiled.as_text()
+    # the dp gradient all-reduce and the tp partial-sum all-reduce must
+    # both appear in the partitioned program
+    assert "all-reduce" in hlo, (
+        "no collective in the compiled dp×tp train step"
+    )
+
+
+@pytest.mark.parametrize("geometry", ["base", "large"])
+def test_bucketed_scoring_program_lowers_at_real_geometry(geometry):
+    """The eval-side program: model-axis-sharded anchor bank (CWE-1000
+    path, evaluate/predict_memory.py:113-133) × data-sharded report
+    batch, at workload shapes (512-row bucket, seq 256)."""
+    mesh = create_mesh({"data": DP, "model": TP})
+    cfg = _geometry(geometry)
+    model = MemoryModel(cfg)
+    abstract = _abstract_params(model)
+    params_abs = _with_shardings(abstract, mesh, param_specs(abstract))
+
+    B = 512
+    A = 130  # 129 CWE anchors padded to model-axis divisibility
+    header_dim = 512
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct(
+            (B, SEQ), np.int32, sharding=NamedSharding(mesh, P("data", None))
+        ),
+        "attention_mask": jax.ShapeDtypeStruct(
+            (B, SEQ), np.int32, sharding=NamedSharding(mesh, P("data", None))
+        ),
+    }
+    bank = jax.ShapeDtypeStruct(
+        (A, header_dim),
+        jnp.bfloat16,
+        sharding=NamedSharding(mesh, P("model", None)),
+    )
+
+    def score(p, b, bank):
+        return anchor_probs(
+            model.apply(p, b, anchors=bank, deterministic=True)
+        )
+
+    compiled = jax.jit(score).lower(params_abs, batch, bank).compile()
+    out_shape = jax.eval_shape(score, abstract, batch, bank)
+    assert out_shape.shape == (B, A)
+    hlo = compiled.as_text()
+    assert "all-reduce" in hlo or "all-gather" in hlo

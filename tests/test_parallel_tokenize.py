@@ -1,0 +1,118 @@
+"""Parallel host tokenization (round-4 verdict #5).
+
+Cold-pass tokenization caps corpus throughput on few-core hosts
+(~2.1k texts/s/core measured, docs/full_corpus.md).  The batch path hands
+whole blocks to the rust tokenizer's rayon thread pool
+(``Tokenizer.encode_batch`` — native threads, one per core), so the host
+can feed the chip on any core count.  Contract: per-text output is
+byte-identical to the scalar ``encode``; ``CachedEncoder.encode_many``
+only pays tokenization for unique cache misses.
+"""
+
+import os
+import time
+
+import pytest
+
+from memvul_tpu.data.batching import CachedEncoder
+from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+
+
+@pytest.fixture(scope="module")
+def tok():
+    reports, _ = generate_corpus(seed=11)
+    return WordPieceTokenizer.train_from_corpus(
+        corpus_texts(reports), vocab_size=1024
+    )
+
+
+@pytest.fixture(scope="module")
+def texts():
+    reports, _ = generate_corpus(seed=12)
+    return corpus_texts(reports)[:64]
+
+
+def test_encode_many_matches_scalar_encode(tok, texts):
+    assert tok.encode_many(texts) == [tok.encode(t) for t in texts]
+
+
+def test_encode_many_matches_scalar_encode_with_truncation(tok, texts):
+    for max_length in (8, 16, 128):
+        batch = tok.encode_many(texts, max_length=max_length)
+        scalar = [tok.encode(t, max_length=max_length) for t in texts]
+        assert batch == scalar
+        assert all(len(ids) <= max_length for ids in batch)
+        # truncation keeps the [CLS] ... [SEP] framing
+        assert all(
+            ids[0] == tok.cls_id and ids[-1] == tok.sep_id for ids in batch
+        )
+
+
+class _CountingTokenizer:
+    """Counts texts tokenized through either path."""
+
+    pad_id = 0
+
+    def __init__(self):
+        self.encoded = 0
+
+    def encode(self, text, max_length=None):
+        self.encoded += 1
+        return [2, len(text) % 97 + 5, 3]
+
+    def encode_many(self, texts, max_length=None):
+        self.encoded += len(texts)
+        return [[2, len(t) % 97 + 5, 3] for t in texts]
+
+
+def test_cached_encoder_batch_only_pays_unique_misses():
+    counting = _CountingTokenizer()
+    enc = CachedEncoder(counting, max_length=32)
+    batch = ["aa", "bb", "aa", "cc", "bb"]
+    out = enc.encode_many(batch)
+    assert counting.encoded == 3  # aa, bb, cc — duplicates deduped pre-encode
+    assert out == [enc(t) for t in batch]  # scalar path agrees (and is cached)
+    assert counting.encoded == 3
+    enc.encode_many(["bb", "dd"])
+    assert counting.encoded == 4  # only dd was new
+
+
+def test_cached_encoder_batch_matches_scalar_path(tok, texts):
+    batch_enc = CachedEncoder(tok, max_length=64)
+    scalar_enc = CachedEncoder(tok, max_length=64)
+    assert batch_enc.encode_many(texts) == [scalar_enc(t) for t in texts]
+
+
+def test_cached_encoder_full_cache_still_returns_fresh(tok, texts):
+    enc = CachedEncoder(tok, max_length=64, cache_size=2)
+    out = enc.encode_many(texts)
+    assert out == [tok.encode(t, max_length=64) for t in texts]
+
+
+_USABLE_CPUS = (
+    len(os.sched_getaffinity(0))
+    if hasattr(os, "sched_getaffinity")
+    else (os.cpu_count() or 1)
+)
+
+
+@pytest.mark.skipif(
+    _USABLE_CPUS < 6,
+    reason="the 2x wall-clock assertion needs headroom over CI load "
+    f"(this rig: {_USABLE_CPUS} usable core(s)); correctness is covered "
+    "above",
+)
+def test_encode_many_cold_pass_speedup(tok):
+    """≥2× cold-pass speedup on a multi-core host.  The rayon pool sizes
+    itself to the core count, so 4+ cores clear 2× with margin."""
+    reports, _ = generate_corpus(seed=13)
+    many = (corpus_texts(reports) * 40)[:2000]
+    t0 = time.perf_counter()
+    scalar = [tok.encode(t, max_length=512) for t in many]
+    t_scalar = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batch = tok.encode_many(many, max_length=512)
+    t_batch = time.perf_counter() - t0
+    assert batch == scalar
+    assert t_scalar / t_batch >= 2.0, (t_scalar, t_batch)

@@ -170,6 +170,14 @@ def test_analyze_sweep_ranks_and_decides(tmp_path, monkeypatch, capsys):
         '"unit": "reports/sec", "vs_baseline": 12.63}\n'
     )
     (logs / "bench_flash.out").write_text("crashed before JSON\n")
+    (logs / "bench_longctx_xla.out").write_text(
+        '{"metric": "siamese_scoring_throughput", "value": 40.0, '
+        '"unit": "reports/sec", "vs_baseline": 1.7}\n'
+    )
+    (logs / "bench_longctx_flash.out").write_text(
+        '{"metric": "siamese_scoring_throughput", "value": 90.0, '
+        '"unit": "reports/sec", "vs_baseline": 3.8}\n'
+    )
     proofs = [
         {"kind": "flash_parity_timing", "rows": [
             {"seq_len": 256, "speedup_vs_xla": 0.8},
@@ -189,7 +197,9 @@ def test_analyze_sweep_ranks_and_decides(tmp_path, monkeypatch, capsys):
     monkeypatch.setattr(analyze_sweep, "REPO", tmp_path)
     assert analyze_sweep.main(["logs"]) == 0
     out = capsys.readouterr().out
-    assert "best: bench_auto6" in out
+    assert "best: bench_auto6" in out  # longctx rows never win the 512 sweep
+    assert "flash/xla @4096: 2.25x" in out
+    assert "flash wins the long-context config" in out
     assert "FAILED" in out  # the crashed step is visible, not silent
     assert "keep xla at workload lengths" in out  # 256 lost its A/B
     assert "int8 default is defensible" in out

@@ -76,6 +76,13 @@ step bench_tokens512k 1800 env BENCH_DEVICE_WAIT=60 BENCH_TOKENS=524288 BENCH_RE
 step bench_flash   1800 env BENCH_DEVICE_WAIT=60 BENCH_ATTENTION=flash BENCH_REPORTS=16384 python bench.py
 step bench_int8    1800 env BENCH_DEVICE_WAIT=60 BENCH_QUANT=int8_dynamic BENCH_REPORTS=16384 python bench.py
 
+# 3b. long-context e2e (round-4 verdict stretch #8): full scoring path at
+#     seq 4096, pad-to-cap (BENCH_BUCKETS empty) so every report pays the
+#     4k cost — converts the flash kernel microbenchmark into a workload
+#     claim the reference (folding-only at 512) structurally cannot match
+step bench_longctx_xla   2400 env BENCH_DEVICE_WAIT=60 BENCH_SEQ_LEN=4096 BENCH_BUCKETS= BENCH_TOKENS=262144 BENCH_REPORTS=4096 python bench.py
+step bench_longctx_flash 2400 env BENCH_DEVICE_WAIT=60 BENCH_SEQ_LEN=4096 BENCH_BUCKETS= BENCH_TOKENS=262144 BENCH_REPORTS=4096 BENCH_ATTENTION=flash python bench.py
+
 # 4. streaming rehearsal: the FULL predict_file path (writer thread and
 #    all) at 16k vs 102k — reports/s must stay flat
 step streaming     7200 python tools/streaming_rehearsal.py
