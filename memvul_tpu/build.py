@@ -280,11 +280,14 @@ def _auto_buckets_for_corpus(
 
     from .data.batching import auto_buckets
 
-    lengths = [
-        len(tokenizer.encode(inst["text1"], max_length=max_length))
+    texts = [
+        inst["text1"]
         for inst in itertools.islice(
             reader.read(test_path, split="test"), sample
         )
+    ]
+    lengths = [
+        len(ids) for ids in tokenizer.encode_many(texts, max_length=max_length)
     ]
     return auto_buckets(lengths, max_length, n_buckets=n_buckets)
 

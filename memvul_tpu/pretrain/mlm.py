@@ -363,12 +363,18 @@ class MLMTrainer:
         started = time.perf_counter()
         chunks: List[np.ndarray] = []
         offsets = np.zeros(len(lines) + 1, dtype=np.int64)
-        for i, text in enumerate(lines):
-            seq = np.asarray(
-                self.tokenizer.encode(text, max_length=c.max_length), np.int32
-            )
-            chunks.append(seq)
-            offsets[i + 1] = offsets[i] + len(seq)
+        # block-wise encode_many: the rust tokenizer's thread pool does
+        # the corpus pass in parallel (1.1M lines would otherwise pin one
+        # Python thread — the reference parallelizes the same pass with
+        # datasets.map worker processes, run_mlm_wwm.py:322-333)
+        i = 0
+        for start in range(0, len(lines), 8192):
+            block = lines[start : start + 8192]
+            for seq in self.tokenizer.encode_many(block, max_length=c.max_length):
+                seq = np.asarray(seq, np.int32)
+                chunks.append(seq)
+                offsets[i + 1] = offsets[i] + len(seq)
+                i += 1
         self._flat_ids = (
             np.concatenate(chunks) if chunks else np.zeros(0, np.int32)
         )
@@ -457,11 +463,10 @@ class MLMTrainer:
         masked_total = 0.0
         for start in range(0, len(lines), rows):
             seqs = [
-                np.asarray(
-                    self.tokenizer.encode(text, max_length=c.max_length),
-                    np.int32,
+                np.asarray(ids, np.int32)
+                for ids in self.tokenizer.encode_many(
+                    lines[start : start + rows], max_length=c.max_length
                 )
-                for text in lines[start : start + rows]
             ]
             masked, mask, labels = self._masked_rows(seqs, rows, rng)
             s, k = self._eval_sums(params, masked, mask, labels)

@@ -115,9 +115,11 @@ def test_mlm_refuses_to_clobber_non_checkpoint_dir(ws, tmp_path):
 # -- tokenize-once pipeline ----------------------------------------------------
 
 def test_mlm_tokenizes_corpus_only_once(ws, corpus_file, monkeypatch):
-    """The packed token cache means exactly one tokenizer.encode per line
-    for the WHOLE run — epochs after the first only shuffle + mask
-    (reference tokenizes once via datasets.map, run_mlm_wwm.py:322-333)."""
+    """The packed token cache means each corpus line is tokenized exactly
+    once for the WHOLE run — epochs after the first only shuffle + mask
+    (reference tokenizes once via datasets.map, run_mlm_wwm.py:322-333).
+    Counts texts through BOTH tokenizer entry points (the corpus pass
+    goes through the parallel ``encode_many``)."""
     cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
     t = MLMTrainer(cfg, ws["tokenizer"], _tiny_cfg(ws, num_epochs=3))
     n_lines = sum(
@@ -125,12 +127,18 @@ def test_mlm_tokenizes_corpus_only_once(ws, corpus_file, monkeypatch):
     )
     calls = {"n": 0}
     real_encode = t.tokenizer.encode
+    real_encode_many = t.tokenizer.encode_many
 
     def counting(text, **kw):
         calls["n"] += 1
         return real_encode(text, **kw)
 
+    def counting_many(texts, **kw):
+        calls["n"] += len(texts)
+        return real_encode_many(texts, **kw)
+
     monkeypatch.setattr(t.tokenizer, "encode", counting)
+    monkeypatch.setattr(t.tokenizer, "encode_many", counting_many)
     t.train(corpus_file)
     assert calls["n"] == n_lines
 

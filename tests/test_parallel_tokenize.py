@@ -105,7 +105,8 @@ _USABLE_CPUS = (
 )
 def test_encode_many_cold_pass_speedup(tok):
     """≥2× cold-pass speedup on a multi-core host.  The rayon pool sizes
-    itself to the core count, so 4+ cores clear 2× with margin."""
+    itself to the core count; the 6-core gate leaves headroom so CI load
+    can't flake the wall-clock ratio."""
     reports, _ = generate_corpus(seed=13)
     many = (corpus_texts(reports) * 40)[:2000]
     t0 = time.perf_counter()
