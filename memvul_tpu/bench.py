@@ -27,7 +27,8 @@ Env knobs: BENCH_SEQ_LEN (cap, default 512), BENCH_BUCKETS (comma list,
 default "64,128,256,512"; "auto" = padding-minimizing DP boundaries from
 a corpus length sample, BENCH_BUCKET_COUNT of them, default 6; empty
 string = pad-everything-to-cap mode),
-BENCH_TOKENS (token budget per batch, default 524288 ≈ batch 1024 at 512),
+BENCH_TOKENS (token budget per batch, default 262144 ≈ batch 512 at 512;
+the on-chip sweep measured it ahead of 512k),
 BENCH_REPORTS (default 32768), BENCH_ATTENTION (xla | flash, default xla),
 BENCH_QUANT (int8_dynamic — route dense contractions through the MXU's
 int8 path; same params, numerics bounded by the quantdrift proof),

@@ -80,8 +80,12 @@ step bench_int8    1800 env BENCH_DEVICE_WAIT=60 BENCH_QUANT=int8_dynamic BENCH_
 #     seq 4096, pad-to-cap (BENCH_BUCKETS empty) so every report pays the
 #     4k cost — converts the flash kernel microbenchmark into a workload
 #     claim the reference (folding-only at 512) structurally cannot match
-step bench_longctx_xla   2400 env BENCH_DEVICE_WAIT=60 BENCH_SEQ_LEN=4096 BENCH_BUCKETS= BENCH_TOKENS=262144 BENCH_REPORTS=4096 python bench.py
-step bench_longctx_flash 2400 env BENCH_DEVICE_WAIT=60 BENCH_SEQ_LEN=4096 BENCH_BUCKETS= BENCH_TOKENS=262144 BENCH_REPORTS=4096 BENCH_ATTENTION=flash python bench.py
+# token budget 32k = batch 8 at 4096: the XLA path materializes
+# [B, H, T, T] attention scores (8×12×4096²×2B ≈ 3.2 GB bf16) — batch 64
+# would want ~26 GB and OOM a 16 GB chip; flash is O(T·D) but both rows
+# use the same budget so the A/B is apples-to-apples
+step bench_longctx_xla   2400 env BENCH_DEVICE_WAIT=60 BENCH_SEQ_LEN=4096 BENCH_BUCKETS= BENCH_TOKENS=32768 BENCH_REPORTS=2048 python bench.py
+step bench_longctx_flash 2400 env BENCH_DEVICE_WAIT=60 BENCH_SEQ_LEN=4096 BENCH_BUCKETS= BENCH_TOKENS=32768 BENCH_REPORTS=2048 BENCH_ATTENTION=flash python bench.py
 
 # 4. streaming rehearsal: the FULL predict_file path (writer thread and
 #    all) at 16k vs 102k — reports/s must stay flat
