@@ -29,7 +29,7 @@ from typing import Any, Dict, Optional, Union
 
 from flax import serialization
 
-from .config import merge_overrides
+from .config import loads_config, merge_overrides
 
 ARCHIVE_NAME = "model.tar.gz"
 
@@ -91,7 +91,11 @@ def load_archive(
         config = json.loads((tmp / "config.json").read_text())
         if overrides:
             if isinstance(overrides, str):
-                overrides = json.loads(overrides)
+                # the Jsonnet-subset parser, not bare json.loads: override
+                # strings are often the shipped test_config_*.json files
+                # verbatim (`--overrides "$(cat configs/...)"`) and those
+                # carry // comments and trailing commas
+                overrides = loads_config(overrides)
             config = merge_overrides(config, overrides)
         vocab_file = tmp / "vocab.txt"
         tok_file = tmp / "tokenizer.json"

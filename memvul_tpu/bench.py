@@ -23,10 +23,11 @@ probability that a 512-report batch contains no capped report is
 cost IS the 512-token cost.  Our length-binned batcher is the structural
 win being measured.
 
-Env knobs: BENCH_SEQ_LEN (cap, default 512), BENCH_BUCKETS (comma list,
-default "64,128,256,512"; "auto" = padding-minimizing DP boundaries from
-a corpus length sample, BENCH_BUCKET_COUNT of them, default 6; empty
-string = pad-everything-to-cap mode),
+Env knobs: BENCH_SEQ_LEN (cap, default 512), BENCH_BUCKETS ("auto" —
+the default — derives padding-minimizing DP boundaries from a corpus
+length sample, BENCH_BUCKET_COUNT of them, default 8: the static cost
+model puts auto-8 at 1.339x emitted/true tokens vs hand-list 1.445x;
+a comma list pins explicit boundaries; empty string = pad-to-cap mode),
 BENCH_TOKENS (token budget per batch, default 262144 ≈ batch 512 at 512;
 the on-chip sweep measured it ahead of 512k),
 BENCH_REPORTS (default 32768), BENCH_ATTENTION (xla | flash, default xla),
@@ -94,7 +95,13 @@ def _run_bench() -> None:
     from memvul_tpu.models import BertConfig, MemoryModel
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
-    buckets_env = os.environ.get("BENCH_BUCKETS", "64,128,256,512")
+    # default flipped to auto-8 in round 5: simulating the REAL batcher
+    # over the realistic 32k-report corpus at the 256k token budget emits
+    # 1.339x the true token count with auto-8 boundaries vs 1.445x with
+    # the hand 64/128/256/512 (and 1.391x with auto-6) — ~7% less device
+    # work at identical batch counts (26-28); the staged on-chip sweep
+    # (bench_auto8 vs bench_hand16k) confirms the flip with wall-clock
+    buckets_env = os.environ.get("BENCH_BUCKETS", "auto")
     auto_bucket_mode = buckets_env == "auto"
     if auto_bucket_mode:
         buckets = None  # derived from a corpus length sample below
@@ -167,12 +174,12 @@ def _run_bench() -> None:
         # boundaries at the corpus's natural knees instead of hand-picked
         # powers of two — same sampling recipe as the `"buckets": "auto"`
         # evaluation-config path so bench and production eval measure one
-        # bucketing policy.  6 boundaries ≈ 10% fewer padded tokens than
-        # the hand 64/128/256/512 on the realistic length distribution;
-        # beyond 8 the win flattens while per-bucket compile cost grows
+        # bucketing policy.  8 boundaries is the measured knee (emitted/
+        # true tokens 1.339x vs 1.391x at 6, 1.445x hand — the cost model
+        # above); more buckets add per-shape compile cost for thin gains
         from memvul_tpu.build import _auto_buckets_for_corpus
 
-        n_buckets = int(os.environ.get("BENCH_BUCKET_COUNT", "6"))
+        n_buckets = int(os.environ.get("BENCH_BUCKET_COUNT", "8"))
         buckets = _auto_buckets_for_corpus(
             reader, ws["tokenizer"], ws["paths"]["test"], seq_len,
             n_buckets=n_buckets,

@@ -200,6 +200,25 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
     for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
         assert auto_metrics[key] == pytest.approx(metrics[key], abs=1e-6), key
 
+    # the SHIPPED override file, verbatim (// comments and all), against a
+    # tiny-position archive: the Jsonnet-tolerant override parse plus the
+    # max_length→max_position_embeddings clamp must make this just work
+    # instead of crashing in the encoder (the override names 512, the
+    # tiny model has 128 positions)
+    shipped_dir = tmp_path / "eval_shipped"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(shipped_dir), "--name", "memvul", "--no-mesh",
+        "--overrides",
+        (CONFIGS_DIR / "test_config_memory.json").read_text(),
+    ])
+    assert rc == 0
+    shipped_metrics = json.loads(
+        (shipped_dir / "memvul_metric_all.json").read_text()
+    )
+    for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
+        assert key in shipped_metrics
+
 
 def test_cli_pretrain_with_eval_and_hf_export(ws, tmp_path, capsys):
     """cmd_pretrain end-to-end: tiny MLM run + held-out eval
