@@ -67,7 +67,7 @@ step bench_default 2400 env BENCH_DEVICE_WAIT=60 python bench.py
 # 2. bucket sweep (fewer reports to keep sweep cheap; relative rps decides)
 step bench_auto6   1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=auto BENCH_BUCKET_COUNT=6 BENCH_REPORTS=16384 python bench.py
 step bench_auto8   1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=auto BENCH_BUCKET_COUNT=8 BENCH_REPORTS=16384 python bench.py
-step bench_hand16k 1800 env BENCH_DEVICE_WAIT=60 BENCH_REPORTS=16384 python bench.py
+step bench_hand16k 1800 env BENCH_DEVICE_WAIT=60 BENCH_BUCKETS=64,128,256,512 BENCH_REPORTS=16384 python bench.py
 step bench_inflight4 1800 env BENCH_DEVICE_WAIT=60 BENCH_INFLIGHT=4 BENCH_REPORTS=16384 python bench.py
 step bench_tokens512k 1800 env BENCH_DEVICE_WAIT=60 BENCH_TOKENS=524288 BENCH_REPORTS=16384 python bench.py
 
