@@ -326,6 +326,15 @@ def auto_buckets(
     values, inverse = np.unique(aligned, return_inverse=True)
     counts = np.bincount(inverse)
     sums = np.bincount(inverse, weights=ls.astype(np.float64))
+    if int(values[-1]) < max_length:
+        # the cap is a mandatory boundary (coverage contract) — model it
+        # as a zero-count top candidate so the DP can also USE it as a
+        # covering bucket (padding stragglers up to the cap can beat
+        # spending an interior boundary on them) while it still counts
+        # against the n_buckets budget
+        values = np.concatenate([values, [max_length]])
+        counts = np.concatenate([counts, [0]])
+        sums = np.concatenate([sums, [0.0]])
     m = len(values)
     n_pre = np.concatenate([[0], np.cumsum(counts)])
     s_pre = np.concatenate([[0.0], np.cumsum(sums)])
@@ -338,12 +347,12 @@ def auto_buckets(
         )
 
     INF = float("inf")
-    # the cap is always a boundary (coverage contract); when the sample
-    # never reaches it, it comes for free ON TOP of the DP's buckets — so
-    # the DP only gets n_buckets-1 to spend, keeping the total bucket
-    # count (= compiled program count) at n_buckets
-    top_is_cap = int(values[-1]) >= max_length
-    k_max = max(1, n_buckets if top_is_cap else n_buckets - 1)
+    # values[-1] == max_length always holds here (appended above when the
+    # sample stays short), so every k-interval partition ends at the cap
+    # and the total bucket count (= compiled program count) is exactly
+    # the DP's k ≤ n_buckets.  Floor of 1: a non-positive budget degrades
+    # to the single mandatory cap bucket rather than crashing
+    k_max = max(1, n_buckets)
     f = [[INF] * (m + 1) for _ in range(k_max + 1)]
     arg = [[0] * (m + 1) for _ in range(k_max + 1)]
     f[0][0] = 0.0
