@@ -299,12 +299,28 @@ def cmd_selfcheck(args) -> int:
     config = selfcheck_config(ws)
     result = train_from_config(config, workdir / "out")
     archive = result.get("archive")
+    # the reference applies the validation-swept threshold at test
+    # (custom_metric.py:35-52 sweep → predict_memory.py thres); mirror
+    # that instead of a hard 0.5 so the toy run's operating point comes
+    # from its own validation
+    thres = 0.5
+    for em in result.get("history", []):
+        if em.get("epoch") == result.get("best_epoch") and (
+            "validation_s_thres" in em
+        ):
+            swept = float(em["validation_s_thres"])
+            # an empty validation set reports thres 0.0 (metrics.py
+            # empty-dict) — a degenerate everything-positive threshold;
+            # keep the reference's 0.5 default then
+            if swept > 0.0:
+                thres = swept
     metrics = evaluate_from_archive(
         str(workdir / "out"),
         ws["paths"]["test"],
         str(workdir / "eval"),
         name="selfcheck",
         use_mesh=False,
+        thres=thres,
     )
     required = ("TP", "FN", "TN", "FP", "prec", "f1", "auc")
     missing = [k for k in required if k not in metrics]

@@ -65,11 +65,19 @@ def build_tokenizer(cfg: Optional[Dict[str, Any]]):
     return TextTokenizer.from_config(cfg or {})
 
 
-def build_reader(cfg: Optional[Dict[str, Any]]):
+def build_reader(cfg: Optional[Dict[str, Any]], seed: Optional[int] = None):
+    """``seed`` (usually the config's ``random_seed``) reaches the
+    reader's pair-sampling RNG unless the reader block pins its own —
+    the reference gets the same property from AllenNLP's global
+    ``random_seed`` (config_memory.json:6); without it, online pair
+    sampling draws from OS entropy and two identically-configured runs
+    train on different pair streams."""
     from .data.readers import DatasetReader
 
     cfg = dict(cfg or {})
     cfg.setdefault("type", "reader_memory")
+    if seed is not None:
+        cfg.setdefault("seed", seed)
     return DatasetReader.from_config(cfg)
 
 
@@ -206,7 +214,7 @@ def train_from_config(
 
     seed = int(config.get("random_seed", 2021))
     tokenizer = build_tokenizer(config.get("tokenizer"))
-    reader = build_reader(config.get("dataset_reader"))
+    reader = build_reader(config.get("dataset_reader"), seed=seed)
     model_cfg = config.get("model") or {}
     model = build_model(model_cfg, tokenizer.vocab_size)
     params = init_params(model, seed)

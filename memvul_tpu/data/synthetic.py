@@ -229,7 +229,11 @@ def build_workspace(tmp_dir, seed: int = 0, **corpus_kwargs):
     paths["anchors"].write_text(json.dumps(anchors))
 
     texts = corpus_texts(reports) + [a for a in anchors.values()]
-    tokenizer = WordPieceTokenizer.train_from_corpus(
+    # deterministic vocabulary, not the rust trainer: the trainer's
+    # hashmap tie-breaking is per-process random (even vocab size can
+    # differ run to run), which would make selfcheck/bench artifacts
+    # non-reproducible despite every seed being pinned
+    tokenizer = WordPieceTokenizer.build_deterministic(
         texts, vocab_size=2048, save_path=paths["tokenizer"]
     )
     return {

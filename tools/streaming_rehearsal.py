@@ -30,7 +30,13 @@ sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(REPO / "tools"))
 
 
-def run(sizes, model_preset: str, seq_len: int, tokens_per_batch: int) -> dict:
+def run(
+    sizes,
+    model_preset: str,
+    seq_len: int,
+    tokens_per_batch: int,
+    min_ratio: float = 0.9,
+) -> dict:
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -129,14 +135,19 @@ def run(sizes, model_preset: str, seq_len: int, tokens_per_batch: int) -> dict:
         "seq_len": seq_len,
         "rows": rows,
         "large_over_small_rps": ratio,
+        # self-describing artifact: which acceptance bar this run was
+        # gated against (0.9 on-chip; CPU plumbing tests pass looser)
+        "min_ratio": min_ratio,
     }
     import tpu_proofs
 
     tpu_proofs._record("streaming_scale", payload)
     tpu_proofs.write_smoke_md()
     # the acceptance: throughput at the large scale within 10% of small
-    # (no host-side sag as the corpus grows)
-    assert ratio > 0.9, payload
+    # (no host-side sag as the corpus grows).  ``min_ratio`` is the
+    # on-chip gate; CPU plumbing tests pass a looser bound — wall-clock
+    # ratios on a loaded 1-core host are not the claim under test there
+    assert ratio > min_ratio, payload
     return payload
 
 
