@@ -359,6 +359,7 @@ def evaluate_from_archive(
     tokens_per_batch = eval_cfg.get("tokens_per_batch")
     if tokens_per_batch is not None:
         tokens_per_batch = int(tokens_per_batch)
+    inflight = int(eval_cfg.get("inflight") or 2)  # null-tolerant, like tokens_per_batch
 
     out_results = out_dir / f"{name}_result.json"
     out_metrics = out_dir / f"{name}_metric_all.json"
@@ -386,6 +387,7 @@ def evaluate_from_archive(
             buckets=buckets,
             tokens_per_batch=tokens_per_batch,
             thres=thres,
+            inflight=inflight,
         )
     from .evaluate.predict_single import test_single
 
@@ -403,4 +405,5 @@ def evaluate_from_archive(
         max_length=max_length,
         buckets=buckets,
         tokens_per_batch=tokens_per_batch,
+        inflight=inflight,
     )

@@ -191,6 +191,7 @@ class SiamesePredictor:
         test_path: Union[str, Path],
         out_path: Union[str, Path],
         split: Optional[str] = None,
+        inflight: int = 2,
     ) -> Dict[str, float]:
         """Stream a corpus file, write the reference-format result lines,
         return the threshold-swept siamese metrics.
@@ -238,7 +239,7 @@ class SiamesePredictor:
         writer.start()
         try:
             for probs, metas in self.score_instances(
-                reader.read(str(test_path), split=split)
+                reader.read(str(test_path), split=split), inflight=inflight
             ):
                 while not failed.is_set():
                     try:
@@ -295,6 +296,7 @@ def test_siamese(
     buckets: Optional[Sequence[int]] = None,
     tokens_per_batch: Optional[int] = None,
     thres: float = 0.5,
+    inflight: int = 2,
 ) -> Dict[str, float]:
     """End-to-end evaluation mirroring the reference's ``test_siamese``
     (predict_memory.py:49-114) + ``cal_metrics`` (:159-197)."""
@@ -312,7 +314,9 @@ def test_siamese(
         tokens_per_batch=tokens_per_batch,
     )
     predictor.encode_anchors(reader.read_anchors(str(golden_file)))
-    eval_metrics = predictor.predict_file(reader, test_file, out_results)
+    eval_metrics = predictor.predict_file(
+        reader, test_file, out_results, inflight=inflight
+    )
     final = cal_metrics(out_results, thres=thres, out_file=out_metrics)
     final.update({f"s_{k}": v for k, v in eval_metrics.items()})
     return final

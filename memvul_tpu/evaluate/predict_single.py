@@ -73,6 +73,7 @@ class SinglePredictor:
         test_path: Union[str, Path],
         out_path: Union[str, Path],
         split: Optional[str] = None,
+        inflight: int = 2,
     ) -> Dict[str, float]:
         if self.buckets is not None:
             batches = bucketed_batches_from_instances(
@@ -124,7 +125,9 @@ class SinglePredictor:
             f.write(json.dumps(records) + "\n")
 
         with open(out_path, "w") as f:
-            for dev, batch in inflight_pipeline(prefetch(batches), dispatch):
+            for dev, batch in inflight_pipeline(
+                prefetch(batches), dispatch, inflight=inflight
+            ):
                 _drain(dev, batch["meta"], f)
         elapsed = time.perf_counter() - start
         logger.info(
@@ -150,6 +153,7 @@ def test_single(
     max_length: int = 512,
     buckets: Optional[Sequence[int]] = None,
     tokens_per_batch: Optional[int] = None,
+    inflight: int = 2,
 ) -> Dict[str, float]:
     reader = reader or SingleReader()
     if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -164,7 +168,9 @@ def test_single(
         buckets=buckets,
         tokens_per_batch=tokens_per_batch,
     )
-    measured = predictor.predict_file(reader, test_file, out_results)
+    measured = predictor.predict_file(
+        reader, test_file, out_results, inflight=inflight
+    )
     if out_metrics is not None:
         Path(out_metrics).write_text(json.dumps(measured, indent=4))
     return measured

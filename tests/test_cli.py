@@ -220,6 +220,35 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
         assert key in shipped_metrics
 
 
+def test_eval_config_inflight_reaches_dispatch(ws, tmp_path, monkeypatch):
+    """``evaluation.inflight`` (async device dispatch depth) must reach
+    score_instances — it is a first-class sweep knob on chip."""
+    from memvul_tpu.build import evaluate_from_archive
+    from memvul_tpu.evaluate import predict_memory as pm
+
+    config = tiny_memory_config(ws)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+
+    seen = {}
+    real = pm.SiamesePredictor.score_instances
+
+    def spy(self, instances, inflight=2, **kw):
+        seen["inflight"] = inflight
+        return real(self, instances, inflight=inflight, **kw)
+
+    monkeypatch.setattr(pm.SiamesePredictor, "score_instances", spy)
+    evaluate_from_archive(
+        str(ser_dir), ws["paths"]["test"], str(tmp_path / "eval_if"),
+        overrides={"evaluation": {"batch_size": 8, "max_length": 48,
+                                  "inflight": 3}},
+        name="memvul", use_mesh=False,
+    )
+    assert seen["inflight"] == 3
+
+
 def test_cli_pretrain_with_eval_and_hf_export(ws, tmp_path, capsys):
     """cmd_pretrain end-to-end: tiny MLM run + held-out eval
     (validation_data_path → eval_loss/perplexity in the report) + HF
