@@ -359,7 +359,10 @@ def evaluate_from_archive(
     tokens_per_batch = eval_cfg.get("tokens_per_batch")
     if tokens_per_batch is not None:
         tokens_per_batch = int(tokens_per_batch)
-    inflight = int(eval_cfg.get("inflight") or 2)  # null-tolerant, like tokens_per_batch
+    # null-tolerant like tokens_per_batch, but 0 is a real value (fully
+    # synchronous dispatch) and must survive
+    _inflight_cfg = eval_cfg.get("inflight")
+    inflight = 2 if _inflight_cfg is None else int(_inflight_cfg)
 
     out_results = out_dir / f"{name}_result.json"
     out_metrics = out_dir / f"{name}_metric_all.json"
