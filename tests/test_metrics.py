@@ -43,6 +43,55 @@ def test_find_best_threshold_prefers_higher_on_ties():
     assert best["thres"] == pytest.approx(0.89)
 
 
+def test_find_best_threshold_matches_brute_force_property():
+    """Property (hypothesis): for arbitrary label/score sets the sweep
+    returns exactly the max-F1 over the reference's 0.50→0.90 step-0.01
+    grid, with ties resolved to the HIGHEST threshold (the reference's
+    ``>=``-update arithmetic, custom_metric.py:35-52).  This metric
+    gates model selection (+s_f1-score), so 'best' must be provable, not
+    approximate."""
+    from hypothesis import given, settings, strategies as st
+
+    def prf(tp, fn, fp):
+        p = tp / (tp + fp) if tp + fp else 0.0
+        r = tp / (tp + fn) if tp + fn else 0.0
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    @settings(max_examples=80, deadline=None)
+    @given(
+        st.lists(
+            st.tuples(
+                st.integers(min_value=0, max_value=1),
+                st.floats(min_value=0.0, max_value=1.0, allow_nan=False),
+            ),
+            min_size=1,
+            max_size=30,
+        )
+    )
+    def check(pairs):
+        labels = [l for l, _ in pairs]
+        scores = [s for _, s in pairs]
+        grid = np.arange(0.5, 0.9, 0.01)
+        f1s = []
+        for t in grid:
+            preds = [1 if s >= t else 0 for s in scores]
+            tp = sum(1 for l, p in zip(labels, preds) if l and p)
+            fp = sum(1 for l, p in zip(labels, preds) if not l and p)
+            fn = sum(1 for l, p in zip(labels, preds) if l and not p)
+            f1s.append(prf(tp, fn, fp))
+        best_f1 = max(f1s)
+        # highest grid threshold attaining the max — including the
+        # all-zero case, where the ``>=`` update walks best to the LAST
+        # grid point (~0.89); the seeded interval[0] fallback row is
+        # reachable only for an empty grid
+        best_t = grid[max(i for i, f in enumerate(f1s) if f == best_f1)]
+        got = find_best_threshold(labels, scores)
+        assert got["f1"] == pytest.approx(best_f1)
+        assert got["thres"] == pytest.approx(best_t)
+
+    check()
+
+
 def test_find_best_threshold_range_bounds():
     labels = [1, 0]
     scores = [0.45, 0.2]  # positive below sweep range -> F1 0 everywhere
