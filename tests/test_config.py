@@ -208,6 +208,44 @@ def test_jsonnet_identifier_not_substituted_inside_strings():
     assert cfg == {"note": "seed stays literal", "s": 7}
 
 
+def test_jsonnet_parser_roundtrips_fuzzed_comments_and_trailing_commas():
+    """Property (hypothesis): for ARBITRARY JSON documents, injecting
+    ``//`` comments at every line end and trailing commas before every
+    closing bracket must not change the parsed value — string payloads
+    (which may themselves contain ``//``, quotes, or braces) included.
+    This fuzzes the comment-stripper/string-scanner interaction beyond
+    the hand-written cases."""
+    import re
+
+    from hypothesis import given, settings, strategies as st
+
+    json_values = st.recursive(
+        st.none()
+        | st.booleans()
+        | st.integers(min_value=-(10**9), max_value=10**9)
+        | st.floats(allow_nan=False, allow_infinity=False, width=32)
+        | st.text(max_size=20),
+        lambda children: st.lists(children, max_size=4)
+        | st.dictionaries(st.text(max_size=8), children, max_size=4),
+        max_leaves=12,
+    )
+
+    trailing_comma_re = re.compile(r"(?m)([^\s{\[,])\n(\s*[}\]])")
+
+    @settings(max_examples=80, deadline=None)
+    @given(json_values, st.text(max_size=12))
+    def check(value, comment):
+        text = json.dumps(value, indent=2)
+        text = trailing_comma_re.sub(r"\1,\n\2", text)
+        comment_body = comment.replace("\n", " ").replace("\r", " ")
+        text = "\n".join(
+            f"{line}  // {comment_body}" for line in text.splitlines()
+        )
+        assert loads_config(text) == json.loads(json.dumps(value))
+
+    check()
+
+
 def test_jsonnet_local_does_not_corrupt_exponent_literals():
     """A local named like an exponent tail (``e5``) must not be
     substituted inside numeric literals: ``1e5`` stays 100000.0, and the
