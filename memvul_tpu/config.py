@@ -200,7 +200,11 @@ def _assign(node: Dict[str, Any], parts: list, value: Any) -> None:
     elif isinstance(value, dict) and isinstance(node.get(key), dict):
         _deep_merge(node[key], value)
     else:
-        node[key] = value
+        # deepcopy, never alias: the merged config must not share
+        # structure with the caller's overrides dict — a later dotted-key
+        # assignment (or any downstream edit of the merged config) would
+        # otherwise mutate the overrides object the caller still holds
+        node[key] = copy.deepcopy(value)
 
 
 def _deep_merge(node: Dict[str, Any], overrides: Dict[str, Any]) -> None:
@@ -208,7 +212,7 @@ def _deep_merge(node: Dict[str, Any], overrides: Dict[str, Any]) -> None:
         if isinstance(value, dict) and isinstance(node.get(key), dict):
             _deep_merge(node[key], value)
         else:
-            node[key] = value
+            node[key] = copy.deepcopy(value)  # same no-aliasing contract
 
 
 def save_config(cfg: Dict[str, Any], path: Union[str, Path]) -> None:

@@ -208,6 +208,84 @@ def test_jsonnet_identifier_not_substituted_inside_strings():
     assert cfg == {"note": "seed stays literal", "s": 7}
 
 
+def test_merge_overrides_laws_property():
+    """Property (hypothesis): for arbitrary nested dicts, the override
+    merge obeys its three laws — every overridden leaf reads back as the
+    override value, every base path NOT named by an override survives
+    unchanged, and the base dict itself is never mutated (deep copy).
+    These are the semantics the archived-config eval overrides depend on
+    (reference: predict_memory.py:60-67)."""
+    import copy as _copy
+
+    from hypothesis import given, settings, strategies as st
+
+    from memvul_tpu.config import merge_overrides
+
+    keys = st.sampled_from(list("abcd"))
+    scalars = st.integers(min_value=0, max_value=99) | st.text(max_size=4)
+    nested = st.recursive(
+        scalars, lambda c: st.dictionaries(keys, c, max_size=3), max_leaves=8
+    )
+    dicts = st.dictionaries(keys, nested, max_size=3)
+
+    def leaves(d, prefix=()):
+        for k, v in d.items():
+            if isinstance(v, dict):
+                yield from leaves(v, prefix + (k,))
+            else:
+                yield prefix + (k,), v
+
+    def lookup(d, path):
+        for k in path:
+            d = d[k]
+        return d
+
+    @settings(max_examples=80, deadline=None)
+    @given(dicts, dicts)
+    def check(base, overrides):
+        before = _copy.deepcopy(base)
+        merged = merge_overrides(base, overrides)
+        assert base == before  # no mutation
+        # law 1: every override leaf reads back verbatim
+        for path, v in leaves(overrides):
+            assert lookup(merged, path) == v
+        # law 2: a base leaf survives iff no override replacement touches
+        # its path — mirroring _deep_merge exactly: descent continues only
+        # while BOTH sides are dicts; any other collision replaces
+        def survives(base_node, ov_node, path):
+            k = path[0]
+            if k not in ov_node:
+                return True
+            if (
+                len(path) > 1
+                and isinstance(ov_node[k], dict)
+                and isinstance(base_node[k], dict)
+            ):
+                return survives(base_node[k], ov_node[k], path[1:])
+            return False
+        for path, v in leaves(before):
+            if survives(before, overrides, path):
+                assert lookup(merged, path) == v
+
+    check()
+
+
+def test_merge_overrides_never_aliases_or_mutates_overrides():
+    """Regression (round-5 review): a dict override assigned by
+    replacement used to be ALIASED into the merged config, so a later
+    dotted-key assignment under the same prefix (or any downstream edit
+    of the merged config) mutated the caller's overrides object."""
+    from memvul_tpu.config import merge_overrides
+
+    overrides = {"a": {"b": 1}, "a.c": 2}
+    before = {"a": {"b": 1}, "a.c": 2}
+    merged = merge_overrides({}, overrides)
+    assert merged == {"a": {"b": 1, "c": 2}}
+    assert overrides == before  # caller's dict untouched
+    merged["a"]["b"] = 99
+    assert overrides["a"]["b"] == 1  # no shared structure either
+
+
 def test_jsonnet_parser_roundtrips_fuzzed_comments_and_trailing_commas():
     """Property (hypothesis): for ARBITRARY JSON documents, injecting
     ``//`` comments at every line end and trailing commas before every

@@ -82,8 +82,10 @@ def test_ring_matches_xla_for_arbitrary_length_mixes(seq_mesh):
     T = 64
     ring_fn = make_ring_attention(seq_mesh)
 
+    # batch fixed at 4: a varying batch dim would force one JIT compile
+    # per distinct size inside the hypothesis loop for no coverage gain
     @settings(max_examples=20, deadline=None)
-    @given(st.lists(st.integers(min_value=0, max_value=T), min_size=2, max_size=4),
+    @given(st.lists(st.integers(min_value=0, max_value=T), min_size=4, max_size=4),
            st.integers(min_value=0, max_value=2**31 - 1))
     def check(lengths, seed):
         q, k, v = _qkv(b=len(lengths), t=T, seed=seed)
