@@ -502,6 +502,43 @@ def test_auto_buckets_exact_on_two_clusters():
     assert b == (32, 128, 512)
 
 
+def test_split_by_project_partition_property():
+    """Property (hypothesis): for arbitrary report→project assignments,
+    the project-level split is a PARTITION of the reports, no project
+    ever straddles the boundary (the leak-guard invariant, reference:
+    utils.py:115-152), and a fixed seed is reproducible."""
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        st.lists(st.integers(min_value=0, max_value=9), min_size=1, max_size=40),
+        st.floats(min_value=0.0, max_value=0.9),
+        st.integers(min_value=0, max_value=999),
+    )
+    def check(project_ids, frac, seed):
+        reports = [
+            {"Issue_Url": f"https://github.com/org{p}/repo{p}/issues/{i}",
+             "idx": i}
+            for i, p in enumerate(project_ids)
+        ]
+        train, test = split_by_project(reports, held_out_frac=frac, seed=seed)
+        # partition: every report lands on exactly one side
+        assert sorted(r["idx"] for r in train + test) == list(
+            range(len(reports))
+        )
+        # corpus order is preserved WITHIN each side (no group-by reshuffle)
+        assert [r["idx"] for r in train] == sorted(r["idx"] for r in train)
+        assert [r["idx"] for r in test] == sorted(r["idx"] for r in test)
+        # leak guard: no project appears on both sides
+        proj = lambda r: extract_project(r["Issue_Url"])
+        assert not ({proj(r) for r in train} & {proj(r) for r in test})
+        # determinism
+        train2, test2 = split_by_project(reports, held_out_frac=frac, seed=seed)
+        assert train == train2 and test == test2
+
+    check()
+
+
 def test_auto_buckets_is_exactly_optimal_vs_brute_force():
     """Property (hypothesis): the interval-partition DP's padded-token
     total equals the brute-force optimum over ALL aligned boundary
