@@ -267,6 +267,19 @@ def cmd_bench(args) -> int:
     return int(bench_main() or 0)
 
 
+def cmd_doctor(args) -> int:
+    """Environment/artifact self-diagnosis (utils/doctor.py)."""
+    from .utils.doctor import run_doctor
+
+    report = run_doctor(
+        config=args.config,
+        device_timeout_s=args.device_timeout,
+        skip_device=args.skip_device,
+    )
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report["ok"] else 1
+
+
 def cmd_selfcheck(args) -> int:
     """One-command acceptance run: synthetic corpus → tiny Siamese train →
     archive → evaluate → metric-contract check.  Exercises every layer
@@ -397,6 +410,21 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bench", help="run the throughput benchmark")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "doctor",
+        help="environment/artifact self-diagnosis: device, mesh, "
+        "vocabulary genuineness, data artifacts, native normalizer, "
+        "compile cache (one JSON report; exit 1 on any failed check)",
+    )
+    p.add_argument("--config", default="configs/config_memory.json",
+                   help="config whose tokenizer/data paths to check")
+    p.add_argument("--device-timeout", type=float, default=90.0,
+                   help="seconds before declaring the device op wedged")
+    p.add_argument("--skip-device", action="store_true",
+                   help="skip the device probe (e.g. while another "
+                   "process holds the serialized TPU tunnel)")
+    p.set_defaults(fn=cmd_doctor)
 
     p = sub.add_parser(
         "parity",

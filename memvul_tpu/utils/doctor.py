@@ -1,0 +1,197 @@
+"""Environment / artifact self-diagnosis (``python -m memvul_tpu doctor``).
+
+The reference has no operational tooling — a user discovers a missing
+``vocab.txt`` or a wedged device only when training crashes hours in
+(or worse, silently trains on the fallback vocabulary).  The doctor
+front-loads every such check into one JSON report:
+
+* backend + mesh: device presence, a tiny jitted device op, and a
+  sharded cross-device reduction — ALL device ops run in one child
+  process under a timeout (on a wedged axon tunnel the first device op
+  hangs rather than errors, and a hung doctor is worse than no doctor);
+  ``--skip-device`` skips the whole child (e.g. while another process
+  holds the serialized tunnel);
+* vocabulary: whether the config's ``vocab_path`` exists (the
+  genuine-vs-fallback distinction that decides reference F1 parity,
+  see README "Using the real BERT vocabulary");
+* data artifacts: the train/validation/anchor/CVE files the config names;
+* native normalizer: library builds/loads AND passes its parity
+  self-check;
+* compile cache: where persistent XLA executables go.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+
+_DEVICE_PROBE = """
+from memvul_tpu.utils.platform import honor_platform_env
+honor_platform_env()
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((64, 64))
+s = float((x @ x).sum())
+print("DOCTOR_BACKEND", len(d), d[0].platform, s)
+from memvul_tpu.parallel import create_mesh, shard_batch
+n = len(d)
+mesh = create_mesh({"data": n})
+batch = shard_batch({"x": jnp.arange(n * 4.0).reshape(n * 4, 1)}, mesh)
+total = float(batch["x"].sum())  # cross-device reduction over the shards
+print("DOCTOR_MESH", n, total, float(sum(range(n * 4))))
+"""
+
+
+def _check_device_and_mesh(
+    device_timeout_s: float,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Every device-touching check in ONE timed child process."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _DEVICE_PROBE],
+            capture_output=True, text=True, timeout=device_timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        err = {
+            "ok": False,
+            "error": f"device op hung for {device_timeout_s:.0f}s — backend "
+            "wedged or unreachable (axon: see SMOKE.md tunnel notes)",
+        }
+        return err, dict(err)
+    backend: Dict[str, Any] = {
+        "ok": False,
+        "error": (out.stderr.strip().splitlines() or ["no output"])[-1][:300],
+    }
+    mesh: Dict[str, Any] = dict(backend)
+    for line in out.stdout.splitlines():
+        if line.startswith("DOCTOR_BACKEND"):
+            _, n, platform, s = line.split()
+            backend = {
+                "ok": True,
+                "devices": int(n),
+                "platform": platform,
+                "matmul_sum": float(s),
+            }
+        elif line.startswith("DOCTOR_MESH"):
+            _, n, total, expected = line.split()
+            mesh = {
+                "ok": float(total) == float(expected),
+                "devices": int(n),
+                "sharded_sum": float(total),
+            }
+    return backend, mesh
+
+
+def _load_config_or_error(
+    config_path: Path,
+) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+    """Parse once for every config-dependent check; any failure (absent
+    file, directory, syntax error) becomes a report entry, never a
+    traceback — the CLI promises one JSON report regardless."""
+    from ..config import load_config
+
+    try:
+        return load_config(config_path), None
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"[:300]
+
+
+def _check_vocab(cfg: Optional[Dict], error: Optional[str]) -> Dict[str, Any]:
+    if cfg is None:
+        return {"ok": False, "error": error}
+    tok = cfg.get("tokenizer") or {}
+    vocab = tok.get("vocab_path")
+    trained = tok.get("tokenizer_path")
+    out: Dict[str, Any] = {
+        "vocab_path": vocab,
+        "vocab_exists": bool(vocab and Path(vocab).exists()),
+        "tokenizer_path": trained,
+        "tokenizer_exists": bool(trained and Path(trained).exists()),
+    }
+    if out["vocab_exists"]:
+        out["ok"] = True
+        out["note"] = "genuine vocabulary — reference tokenization exact"
+    elif out["tokenizer_exists"]:
+        out["ok"] = True
+        out["note"] = (
+            "FALLBACK trained tokenizer — training works but F1 parity "
+            "with reference checkpoints needs the real vocab.txt "
+            "(README: 'Using the real BERT vocabulary')"
+        )
+    else:
+        out["ok"] = False
+        out["error"] = "neither vocab_path nor tokenizer_path exists"
+    return out
+
+
+def _check_data(cfg: Optional[Dict], error: Optional[str]) -> Dict[str, Any]:
+    if cfg is None:
+        return {"ok": False, "error": error}
+    reader = cfg.get("dataset_reader") or {}
+    paths = {
+        "train_data_path": cfg.get("train_data_path"),
+        "validation_data_path": cfg.get("validation_data_path"),
+        "anchor_path": reader.get("anchor_path"),
+        "cve_path": reader.get("cve_path"),
+    }
+    missing = sorted(
+        k for k, p in paths.items() if p and not Path(p).exists()
+    )
+    return {"ok": not missing, "paths": paths, "missing": missing}
+
+
+def _check_native() -> Dict[str, Any]:
+    try:
+        from ..data.native import native_available
+
+        return {"ok": True, "enabled": bool(native_available())}
+    except Exception as e:
+        return {"ok": False, "error": str(e)[:300]}
+
+
+def _check_compile_cache() -> Dict[str, Any]:
+    import jax
+
+    from .platform import enable_compilation_cache
+
+    enable_compilation_cache()
+    cache_dir = jax.config.jax_compilation_cache_dir
+    return {
+        "ok": cache_dir is not None,
+        "dir": cache_dir,
+        "entries": len(list(Path(cache_dir).glob("*"))) if cache_dir and Path(
+            cache_dir
+        ).exists() else 0,
+    }
+
+
+def run_doctor(
+    config: str = "configs/config_memory.json",
+    device_timeout_s: float = 90.0,
+    skip_device: bool = False,
+) -> Dict[str, Any]:
+    if skip_device:
+        backend: Dict[str, Any] = {"ok": True, "skipped": True}
+        mesh: Dict[str, Any] = {"ok": True, "skipped": True}
+    else:
+        backend, mesh = _check_device_and_mesh(device_timeout_s)
+    cfg, cfg_error = _load_config_or_error(Path(config))
+    report: Dict[str, Any] = {
+        "backend": backend,
+        "mesh": mesh,
+        "vocabulary": _check_vocab(cfg, cfg_error),
+        "data_artifacts": _check_data(cfg, cfg_error),
+        "native_normalizer": _check_native(),
+        "compile_cache": _check_compile_cache(),
+    }
+    report["ok"] = all(
+        section.get("ok", False) for section in report.values()
+        if isinstance(section, dict)
+    )
+    return report
