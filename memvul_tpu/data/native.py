@@ -42,7 +42,7 @@ import random
 import subprocess
 import threading
 from pathlib import Path
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from .normalize import normalize_text
 
@@ -55,6 +55,7 @@ _LIB = _NATIVE_DIR / "libmemvul_native.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _state: Optional[str] = None  # None=unknown, "ok", "disabled"
+_reason: Optional[str] = None  # why disabled (diagnosis, not control flow)
 
 # documents exercising every pass family; native must agree with Python on
 # all of them before it is trusted
@@ -151,16 +152,19 @@ def _self_check(lib: ctypes.CDLL) -> bool:
 
 def get_native_normalizer() -> Optional[ctypes.CDLL]:
     """The parity-validated native library, or None."""
-    global _lib, _state
+    global _lib, _state, _reason
     with _lock:
         if _state is not None:
             return _lib if _state == "ok" else None
         if os.environ.get("MEMVUL_NATIVE", "1") == "0":
-            _state = "disabled"
+            _state, _reason = "disabled", "MEMVUL_NATIVE=0 (env opt-out)"
             return None
         lib = _load()
-        if lib is None or not _self_check(lib):
-            _state = "disabled"
+        if lib is None:
+            _state, _reason = "disabled", "library build/load failed"
+            return None
+        if not _self_check(lib):
+            _state, _reason = "disabled", "parity self-check FAILED"
             return None
         _lib = lib
         _state = "ok"
@@ -170,6 +174,14 @@ def get_native_normalizer() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return get_native_normalizer() is not None
+
+
+def native_status() -> Dict[str, Optional[str]]:
+    """Diagnostic state: ``{"state": "ok"|"disabled", "reason": ...}`` —
+    distinguishes env opt-out from build failure from parity failure
+    (the doctor surfaces this; ``reason`` is None when enabled)."""
+    get_native_normalizer()
+    return {"state": _state, "reason": _reason}
 
 
 def normalize_batch(
@@ -246,8 +258,9 @@ def _sampled_parity_ok(
 
 
 def _disable_native(reason: str) -> None:
-    global _lib, _state
+    global _lib, _state, _reason
     with _lock:
         _state = "disabled"
+        _reason = reason
         _lib = None
     logger.warning("native normalizer disabled: %s", reason)

@@ -48,16 +48,27 @@ print("DOCTOR_MESH", n, total, float(sum(range(n * 4))))
 def _check_device_and_mesh(
     device_timeout_s: float,
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-    """Every device-touching check in ONE timed child process."""
+    """Every device-touching check in ONE timed child process.
+
+    Kill discipline on timeout reuses the bench supervisor's: the child
+    runs in its own session and gets SIGTERM + grace before SIGKILL, so
+    its PJRT client closes the tunnel connection cleanly instead of
+    becoming one more dead client holding the device lease (the wedge
+    this timeout exists to diagnose — bench.py:_kill_process_group)."""
     import subprocess
     import sys
 
+    from ..bench import _kill_process_group
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _DEVICE_PROBE],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", _DEVICE_PROBE],
-            capture_output=True, text=True, timeout=device_timeout_s,
-        )
+        stdout, stderr = proc.communicate(timeout=device_timeout_s)
     except subprocess.TimeoutExpired:
+        _kill_process_group(proc, grace=10.0)
         err = {
             "ok": False,
             "error": f"device op hung for {device_timeout_s:.0f}s — backend "
@@ -66,10 +77,10 @@ def _check_device_and_mesh(
         return err, dict(err)
     backend: Dict[str, Any] = {
         "ok": False,
-        "error": (out.stderr.strip().splitlines() or ["no output"])[-1][:300],
+        "error": (stderr.strip().splitlines() or ["no output"])[-1][:300],
     }
     mesh: Dict[str, Any] = dict(backend)
-    for line in out.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith("DOCTOR_BACKEND"):
             _, n, platform, s = line.split()
             backend = {
@@ -147,28 +158,42 @@ def _check_data(cfg: Optional[Dict], error: Optional[str]) -> Dict[str, Any]:
 
 
 def _check_native() -> Dict[str, Any]:
+    """Surfaces WHY the native path is off: env opt-out, build failure,
+    and parity-self-check failure are different diagnoses (the last one
+    means native and Python normalization disagree — a red flag, not a
+    preference)."""
     try:
-        from ..data.native import native_available
+        from ..data.native import native_status
 
-        return {"ok": True, "enabled": bool(native_available())}
+        status = native_status()
+        return {
+            # a parity FAILURE is a failed check; opt-out/build-miss are
+            # degraded-but-fine (the Python path is the specification)
+            "ok": "parity" not in (status["reason"] or ""),
+            "state": status["state"],
+            "reason": status["reason"],
+        }
     except Exception as e:
         return {"ok": False, "error": str(e)[:300]}
 
 
 def _check_compile_cache() -> Dict[str, Any]:
-    import jax
+    try:
+        import jax
 
-    from .platform import enable_compilation_cache
+        from .platform import enable_compilation_cache
 
-    enable_compilation_cache()
-    cache_dir = jax.config.jax_compilation_cache_dir
-    return {
-        "ok": cache_dir is not None,
-        "dir": cache_dir,
-        "entries": len(list(Path(cache_dir).glob("*"))) if cache_dir and Path(
-            cache_dir
-        ).exists() else 0,
-    }
+        enable_compilation_cache()
+        cache_dir = jax.config.jax_compilation_cache_dir
+        return {
+            "ok": cache_dir is not None,
+            "dir": cache_dir,
+            "entries": len(list(Path(cache_dir).glob("*")))
+            if cache_dir and Path(cache_dir).exists()
+            else 0,
+        }
+    except Exception as e:  # older jax / exotic plugin without the key
+        return {"ok": False, "error": str(e)[:300]}
 
 
 def run_doctor(

@@ -502,6 +502,46 @@ def test_auto_buckets_exact_on_two_clusters():
     assert b == (32, 128, 512)
 
 
+def test_inflight_pipeline_invariants():
+    """The shared async-dispatch core (both predictors + bench): batches
+    are yielded exactly once in order; the dispatch-ahead depth never
+    exceeds ``inflight`` + 1; ``inflight=0`` degrades to strict
+    dispatch-then-sync alternation; and the input is consumed lazily
+    (never drained ahead of the dispatch window)."""
+    from memvul_tpu.data.batching import inflight_pipeline
+
+    for inflight in (0, 1, 2, 5):
+        events = []
+        consumed = 0
+
+        def batches():
+            nonlocal consumed
+            for i in range(12):
+                consumed += 1
+                yield {"i": i}
+
+        def dispatch(b):
+            events.append(("d", b["i"]))
+            return b["i"] * 10
+
+        yielded = []
+        for result, batch in inflight_pipeline(batches(), dispatch, inflight=inflight):
+            events.append(("y", batch["i"]))
+            yielded.append((result, batch["i"]))
+            # dispatch-ahead bound: dispatched − yielded ≤ inflight (+1
+            # for the batch appended just before this yield fired)
+            d = sum(1 for k, _ in events if k == "d")
+            y = sum(1 for k, _ in events if k == "y")
+            assert d - y <= inflight + 1
+            # laziness: the generator is never drained ahead of dispatch
+            assert consumed == d
+        assert yielded == [(i * 10, i) for i in range(12)]
+        if inflight == 0:
+            # strict alternation after the first dispatch
+            kinds = "".join(k for k, _ in events)
+            assert kinds == "d" + "yd" * 11 + "y"
+
+
 def test_split_by_project_partition_property():
     """Property (hypothesis): for arbitrary report→project assignments,
     the project-level split is a PARTITION of the reports, no project

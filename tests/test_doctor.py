@@ -88,4 +88,4 @@ def test_doctor_missing_config_reports_cleanly(tmp_path, capsys):
     report = json.loads(capsys.readouterr().out)
     assert rc == 1
     assert report["vocabulary"]["ok"] is False
-    assert "missing" in report["vocabulary"]["error"]
+    assert "FileNotFoundError" in report["vocabulary"]["error"]
