@@ -60,18 +60,20 @@ def cmd_train(args) -> int:
 
 def cmd_evaluate(args) -> int:
     from .build import evaluate_from_archive
+    from .utils.profiling import trace_context
 
-    metrics = evaluate_from_archive(
-        args.archive,
-        args.test_path,
-        args.out_dir,
-        overrides=args.overrides,
-        golden_file=args.golden_file,
-        name=args.name,
-        mesh=_parse_mesh(args.mesh),
-        use_mesh=not args.no_mesh,
-        thres=args.threshold,
-    )
+    with trace_context(args.profile):
+        metrics = evaluate_from_archive(
+            args.archive,
+            args.test_path,
+            args.out_dir,
+            overrides=args.overrides,
+            golden_file=args.golden_file,
+            name=args.name,
+            mesh=_parse_mesh(args.mesh),
+            use_mesh=not args.no_mesh,
+            thres=args.threshold,
+        )
     print(json.dumps(metrics, default=float))
     return 0
 
@@ -373,6 +375,9 @@ def main(argv=None) -> int:
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--mesh", default=None)
     p.add_argument("--no-mesh", action="store_true")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the whole eval "
+                   "(same scope bench.py's BENCH_PROFILE uses)")
     p.set_defaults(fn=cmd_evaluate)
 
     p = sub.add_parser("pretrain", help="MLM further-pretraining")

@@ -55,7 +55,9 @@ _LIB = _NATIVE_DIR / "libmemvul_native.so"
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _state: Optional[str] = None  # None=unknown, "ok", "disabled"
-_reason: Optional[str] = None  # why disabled (diagnosis, not control flow)
+_reason: Optional[str] = None  # human text: why disabled
+_kind: Optional[str] = None  # structured: env_optout | load_failed |
+#   parity_failed | runtime_parity_failed (diagnosis, not control flow)
 
 # documents exercising every pass family; native must agree with Python on
 # all of them before it is trusted
@@ -152,19 +154,22 @@ def _self_check(lib: ctypes.CDLL) -> bool:
 
 def get_native_normalizer() -> Optional[ctypes.CDLL]:
     """The parity-validated native library, or None."""
-    global _lib, _state, _reason
+    global _lib, _state, _reason, _kind
     with _lock:
         if _state is not None:
             return _lib if _state == "ok" else None
         if os.environ.get("MEMVUL_NATIVE", "1") == "0":
             _state, _reason = "disabled", "MEMVUL_NATIVE=0 (env opt-out)"
+            _kind = "env_optout"
             return None
         lib = _load()
         if lib is None:
             _state, _reason = "disabled", "library build/load failed"
+            _kind = "load_failed"
             return None
         if not _self_check(lib):
             _state, _reason = "disabled", "parity self-check FAILED"
+            _kind = "parity_failed"
             return None
         _lib = lib
         _state = "ok"
@@ -177,11 +182,12 @@ def native_available() -> bool:
 
 
 def native_status() -> Dict[str, Optional[str]]:
-    """Diagnostic state: ``{"state": "ok"|"disabled", "reason": ...}`` —
-    distinguishes env opt-out from build failure from parity failure
-    (the doctor surfaces this; ``reason`` is None when enabled)."""
+    """Diagnostic state: ``{"state", "reason", "kind"}`` — ``kind`` is the
+    STRUCTURED disable cause (env_optout | load_failed | parity_failed |
+    runtime_parity_failed) so consumers branch on it, never on the
+    human-readable ``reason`` text; both are None when enabled."""
     get_native_normalizer()
-    return {"state": _state, "reason": _reason}
+    return {"state": _state, "reason": _reason, "kind": _kind}
 
 
 def normalize_batch(
@@ -234,7 +240,10 @@ def normalize_batch(
         # drift between the native library and the Python specification —
         # disable native for the rest of the process and recompute this
         # batch authoritatively
-        _disable_native("sampled runtime parity check failed")
+        _disable_native(
+            "sampled runtime parity check failed",
+            kind="runtime_parity_failed",
+        )
         return [normalize_text(t) for t in texts]
     return out
 
@@ -257,10 +266,11 @@ def _sampled_parity_ok(
     return True
 
 
-def _disable_native(reason: str) -> None:
-    global _lib, _state, _reason
+def _disable_native(reason: str, kind: str = "runtime_parity_failed") -> None:
+    global _lib, _state, _reason, _kind
     with _lock:
         _state = "disabled"
         _reason = reason
+        _kind = kind
         _lib = None
     logger.warning("native normalizer disabled: %s", reason)

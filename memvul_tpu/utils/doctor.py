@@ -57,24 +57,36 @@ def _check_device_and_mesh(
     this timeout exists to diagnose — bench.py:_kill_process_group)."""
     import subprocess
     import sys
+    import tempfile
 
     from ..bench import _kill_process_group
 
-    proc = subprocess.Popen(
-        [sys.executable, "-c", _DEVICE_PROBE],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True,
-    )
-    try:
-        stdout, stderr = proc.communicate(timeout=device_timeout_s)
-    except subprocess.TimeoutExpired:
-        _kill_process_group(proc, grace=10.0)
-        err = {
-            "ok": False,
-            "error": f"device op hung for {device_timeout_s:.0f}s — backend "
-            "wedged or unreachable (axon: see SMOKE.md tunnel notes)",
-        }
-        return err, dict(err)
+    # spool child output to temp files, not PIPEs: during the SIGTERM
+    # grace a full 64KB pipe would block the child's shutdown logging and
+    # burn the grace into a SIGKILL — the unclean exit the grace exists
+    # to avoid
+    with tempfile.TemporaryFile("w+") as out_f, tempfile.TemporaryFile(
+        "w+"
+    ) as err_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _DEVICE_PROBE],
+            stdout=out_f, stderr=err_f, text=True,
+            start_new_session=True,
+        )
+        try:
+            proc.wait(timeout=device_timeout_s)
+        except subprocess.TimeoutExpired:
+            _kill_process_group(proc, grace=10.0)
+            err = {
+                "ok": False,
+                "error": f"device op hung for {device_timeout_s:.0f}s — "
+                "backend wedged or unreachable (axon: see SMOKE.md tunnel "
+                "notes)",
+            }
+            return err, dict(err)
+        out_f.seek(0)
+        err_f.seek(0)
+        stdout, stderr = out_f.read(), err_f.read()
     backend: Dict[str, Any] = {
         "ok": False,
         "error": (stderr.strip().splitlines() or ["no output"])[-1][:300],
@@ -167,10 +179,15 @@ def _check_native() -> Dict[str, Any]:
 
         status = native_status()
         return {
-            # a parity FAILURE is a failed check; opt-out/build-miss are
-            # degraded-but-fine (the Python path is the specification)
-            "ok": "parity" not in (status["reason"] or ""),
+            # a parity FAILURE is a failed check (native and Python
+            # normalization disagree); opt-out/build-miss are
+            # degraded-but-fine (the Python path is the specification).
+            # Branch on the structured kind, never the reason text.
+            "ok": status["kind"] not in (
+                "parity_failed", "runtime_parity_failed"
+            ),
             "state": status["state"],
+            "kind": status["kind"],
             "reason": status["reason"],
         }
     except Exception as e:
