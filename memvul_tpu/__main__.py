@@ -45,11 +45,13 @@ def _parse_mesh(spec):
 def cmd_train(args) -> int:
     from .build import train_from_config
     from .config import load_config
+    from .utils.profiling import trace_context
 
     config = load_config(args.config, overrides=args.overrides)
-    result = train_from_config(
-        config, args.serialization_dir, mesh=_parse_mesh(args.mesh)
-    )
+    with trace_context(args.profile):
+        result = train_from_config(
+            config, args.serialization_dir, mesh=_parse_mesh(args.mesh)
+        )
     print(json.dumps({
         "best_epoch": result.get("best_epoch"),
         "best_validation": result.get("best_validation"),
@@ -83,6 +85,8 @@ def cmd_pretrain(args) -> int:
     from .config import load_config
     from .pretrain.mlm import MLMTrainer, MLMTrainerConfig
 
+    from .utils.profiling import trace_context
+
     if args.export_hf:
         import torch  # noqa: F401 — fail fast, not after hours of training
 
@@ -103,7 +107,8 @@ def cmd_pretrain(args) -> int:
     trainer = MLMTrainer(
         bert_cfg, tokenizer, MLMTrainerConfig(**(config.get("trainer") or {}))
     )
-    result = trainer.train(config["train_data_path"])
+    with trace_context(args.profile):
+        result = trainer.train(config["train_data_path"])
     out_dir = Path(config.get("output_dir", "further_pretrain/out_wwm"))
     encoder = trainer.encoder_params()  # one device fetch, shared below
     path = save_encoder_checkpoint(encoder, out_dir)
@@ -362,6 +367,8 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--overrides", default=None,
                    help="JSON string deep-merged onto the config")
     p.add_argument("--mesh", default=None, help='e.g. "data=8"')
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the whole run")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("evaluate", help="evaluate an archived model")
@@ -386,6 +393,8 @@ def main(argv=None) -> int:
     p.add_argument("--export-hf", action="store_true",
                    help="also write an HF-format checkpoint dir the "
                    "reference's AutoModel.from_pretrained consumes")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the MLM run")
     p.set_defaults(fn=cmd_pretrain)
 
     p = sub.add_parser("baseline", help="sklearn baselines")

@@ -220,6 +220,43 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
         assert key in shipped_metrics
 
 
+def test_cli_profile_flags_write_traces(ws, tmp_path):
+    """--profile on train AND pretrain wraps the run in a jax.profiler
+    trace scope; each trace dir must materialize (evaluate shares the
+    same wrapper; bench has BENCH_PROFILE)."""
+    from memvul_tpu.data.synthetic import corpus_texts, generate_corpus
+
+    config = tiny_memory_config(ws, num_epochs=1)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    train_trace = tmp_path / "trace_train"
+    rc = main([
+        "train", str(cfg_path), "-s", str(tmp_path / "out"),
+        "--profile", str(train_trace),
+    ])
+    assert rc == 0
+    assert train_trace.exists() and any(train_trace.rglob("*"))
+
+    reports, _ = generate_corpus(seed=4)
+    train_txt = tmp_path / "mlm.txt"
+    train_txt.write_text("\n".join(corpus_texts(reports)[:24]))
+    mlm_cfg = tmp_path / "pretrain.json"
+    mlm_cfg.write_text(json.dumps({
+        "tokenizer": {"type": "wordpiece",
+                      "tokenizer_path": ws["paths"]["tokenizer"]},
+        "encoder": {"preset": "tiny"},
+        "train_data_path": str(train_txt),
+        "output_dir": str(tmp_path / "out_wwm"),
+        "trainer": {"batch_size": 4, "grad_accum": 1, "max_length": 32,
+                    "num_epochs": 1, "steps_per_epoch": 1,
+                    "warmup_steps": 1},
+    }))
+    mlm_trace = tmp_path / "trace_mlm"
+    rc = main(["pretrain", str(mlm_cfg), "--profile", str(mlm_trace)])
+    assert rc == 0
+    assert mlm_trace.exists() and any(mlm_trace.rglob("*"))
+
+
 def test_eval_config_inflight_reaches_dispatch(ws, tmp_path, monkeypatch):
     """``evaluation.inflight`` (async device dispatch depth) must reach
     score_instances — it is a first-class sweep knob on chip."""
