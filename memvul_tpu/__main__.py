@@ -30,16 +30,32 @@ def _honor_platform_env() -> None:
 
 
 def _parse_mesh(spec):
-    """``"data=8"`` or ``"data=4,model=2"`` → mesh, None otherwise."""
+    """``"data=8"`` or ``"data=4,model=2"`` → mesh, None otherwise.
+    Malformed specs exit with a usage message, not a traceback."""
     if not spec:
         return None
     from .parallel import create_mesh
 
+    allowed = {"data", "model"}  # the axes batch_spec/shard_params act on
     axes = {}
-    for part in spec.split(","):
-        name, size = part.split("=")
-        axes[name.strip()] = int(size)
-    return create_mesh(axes)
+    try:
+        for part in spec.split(","):
+            name, size = part.split("=")
+            name = name.strip()
+            if name not in allowed:
+                # an unknown axis would pass mesh construction but
+                # silently shard nothing (batch_spec keys on "data")
+                raise ValueError(f"unknown axis {name!r}")
+            axes[name] = int(size)
+        return create_mesh(axes)
+    except ValueError as e:
+        print(
+            f'--mesh {spec!r}: {e} (expected e.g. "data=8" or '
+            f'"data=4,model=2"; axes from {sorted(allowed)}; sizes must '
+            "multiply to the device count)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)  # usage error, distinct from exit 1 = run failed
 
 
 def cmd_train(args) -> int:
@@ -48,9 +64,10 @@ def cmd_train(args) -> int:
     from .utils.profiling import trace_context
 
     config = load_config(args.config, overrides=args.overrides)
+    mesh = _parse_mesh(args.mesh)  # validate BEFORE the trace scope opens
     with trace_context(args.profile):
         result = train_from_config(
-            config, args.serialization_dir, mesh=_parse_mesh(args.mesh)
+            config, args.serialization_dir, mesh=mesh
         )
     print(json.dumps({
         "best_epoch": result.get("best_epoch"),
@@ -64,6 +81,7 @@ def cmd_evaluate(args) -> int:
     from .build import evaluate_from_archive
     from .utils.profiling import trace_context
 
+    mesh = _parse_mesh(args.mesh)  # validate BEFORE the trace scope opens
     with trace_context(args.profile):
         metrics = evaluate_from_archive(
             args.archive,
@@ -72,7 +90,7 @@ def cmd_evaluate(args) -> int:
             overrides=args.overrides,
             golden_file=args.golden_file,
             name=args.name,
-            mesh=_parse_mesh(args.mesh),
+            mesh=mesh,
             use_mesh=not args.no_mesh,
             thres=args.threshold,
         )

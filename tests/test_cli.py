@@ -220,6 +220,43 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
         assert key in shipped_metrics
 
 
+def test_cli_mesh_flag_end_to_end(ws, tmp_path):
+    """--mesh through the CLI: dp training over all 8 virtual devices,
+    then evaluation on a dp×tp mesh (model axis → TP param split + the
+    model-sharded anchor-bank path) — the full flag-to-collective chain
+    the library-level mesh tests can't see."""
+    config = tiny_memory_config(ws, batch_size=8)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+    rc = main(["train", str(cfg_path), "-s", str(ser_dir),
+               "--mesh", "data=8"])
+    assert rc == 0
+    assert (ser_dir / "model.tar.gz").exists()
+
+    eval_dir = tmp_path / "eval_mesh"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(eval_dir), "--name", "memvul",
+        "--mesh", "data=4,model=2",
+        "--overrides", json.dumps(
+            {"evaluation": {"batch_size": 16, "max_length": 48}}
+        ),
+    ])
+    assert rc == 0
+    metrics = json.loads((eval_dir / "memvul_metric_all.json").read_text())
+    for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
+        assert key in metrics
+
+    # malformed specs are USAGE errors: exit 2 (not 1 = run failed),
+    # message on stderr, no traceback
+    for bad in ("data=", "data=3", "date=8"):
+        with pytest.raises(SystemExit) as exc:
+            main(["train", str(cfg_path), "-s", str(tmp_path / "x"),
+                  "--mesh", bad])
+        assert exc.value.code == 2, bad
+
+
 def test_cli_profile_flags_write_traces(ws, tmp_path):
     """--profile on train AND pretrain wraps the run in a jax.profiler
     trace scope; each trace dir must materialize (evaluate shares the
