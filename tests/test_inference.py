@@ -1,4 +1,5 @@
 import json
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -103,6 +104,47 @@ def test_writer_thread_error_propagates(ws, memory_setup, tmp_path):
     bad_path = tmp_path / "no_such_dir" / "result.json"
     with pytest.raises(OSError):
         pred.predict_file(reader, ws["paths"]["test"], bad_path)
+
+
+def test_writer_death_mid_stream_does_not_deadlock(
+    ws, memory_setup, tmp_path, monkeypatch
+):
+    """The harder failure window: the writer thread dies AFTER consuming
+    some batches, while the producer may be blocked on the bounded queue.
+    The failure-aware put/drain loops must surface the error promptly —
+    this test completing at all (instead of hanging on q.put) is the
+    assertion."""
+    import memvul_tpu.evaluate.predict_memory as pm
+
+    model, params, reader = memory_setup
+    pred = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=2, max_length=64
+    )
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    # the deadlock window only exists when the producer can outrun the
+    # 16-deep writer queue: guarantee the corpus actually fills it past
+    # the ~3 batches consumed before the synthetic death
+    n_reports = len(json.loads(Path(ws["paths"]["test"]).read_text()))
+    assert n_reports / 2 > 16 + 3, (
+        "synthetic corpus shrank below the queue depth — this test no "
+        "longer covers the blocked-producer window"
+    )
+
+    real_dumps = pm.json.dumps
+    calls = {"n": 0}
+
+    def dying_dumps(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] >= 3:  # die mid-stream, after real progress
+            raise RuntimeError("synthetic writer failure")
+        return real_dumps(*a, **kw)
+
+    monkeypatch.setattr(pm.json, "dumps", dying_dumps)
+    with pytest.raises(RuntimeError, match="synthetic writer failure"):
+        pred.predict_file(
+            reader, ws["paths"]["test"], tmp_path / "result.json"
+        )
+    assert calls["n"] >= 3
 
 
 def test_bucketed_scoring_matches_pad_to_max(ws, memory_setup, tmp_path):
