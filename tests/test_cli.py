@@ -257,6 +257,39 @@ def test_cli_mesh_flag_end_to_end(ws, tmp_path):
         assert exc.value.code == 2, bad
 
 
+def test_cli_evaluate_golden_file_swaps_anchor_bank(ws, tmp_path):
+    """--golden-file replaces the archive config's anchor bank at eval
+    time (reference: predict_memory.py's golden file argument) — the
+    entry point of the CWE-1000 full-view flow.  Result records must
+    score against the ALTERNATE bank's labels."""
+    config = tiny_memory_config(ws)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+
+    anchors = json.loads(Path(ws["paths"]["anchors"]).read_text())
+    extra_label = "CWE-TEST-ONLY"
+    anchors[extra_label] = "A synthetic anchor describing a test weakness."
+    alt = tmp_path / "alt_anchors.json"
+    alt.write_text(json.dumps(anchors))
+
+    eval_dir = tmp_path / "eval_alt"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(eval_dir), "--name", "memvul", "--no-mesh",
+        "--golden-file", str(alt),
+        "--overrides", json.dumps(
+            {"evaluation": {"batch_size": 8, "max_length": 48}}
+        ),
+    ])
+    assert rc == 0
+    first_line = (eval_dir / "memvul_result.json").read_text().splitlines()[0]
+    record = json.loads(first_line)[0]
+    assert extra_label in record["predict"]
+    assert len(record["predict"]) == len(anchors)
+
+
 def test_cli_profile_flags_write_traces(ws, tmp_path):
     """--profile on train AND pretrain wraps the run in a jax.profiler
     trace scope; each trace dir must materialize (evaluate shares the
