@@ -248,6 +248,34 @@ def test_cli_mesh_flag_end_to_end(ws, tmp_path):
     for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
         assert key in metrics
 
+    # the shipped default POLICY (auto buckets + token budget) under the
+    # same dp×tp mesh: metrics must match the pad-to-max mesh run
+    # exactly (batching never changes scores).  The row-divisibility
+    # invariant the mesh path relies on (multiple_of = 8×n_data,
+    # predict_memory.py:67) is asserted on the helper with the exact
+    # multiple this mesh passes:
+    from memvul_tpu.data.batching import bucket_batch_sizes
+
+    sizes = bucket_batch_sizes((16, 32, 48), 1024, multiple_of=8 * 4)
+    assert sizes and all(v % 32 == 0 for v in sizes.values())
+
+    auto_dir = tmp_path / "eval_mesh_auto"
+    rc = main([
+        "evaluate", str(ser_dir), ws["paths"]["test"],
+        "-o", str(auto_dir), "--name", "memvul",
+        "--mesh", "data=4,model=2",
+        "--overrides", json.dumps({"evaluation": {
+            "batch_size": 16, "max_length": 48,
+            "buckets": "auto", "n_buckets": 3, "tokens_per_batch": 1024,
+        }}),
+    ])
+    assert rc == 0
+    auto_metrics = json.loads(
+        (auto_dir / "memvul_metric_all.json").read_text()
+    )
+    for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
+        assert auto_metrics[key] == pytest.approx(metrics[key], abs=1e-5), key
+
     # malformed specs are USAGE errors: exit 2 (not 1 = run failed),
     # message on stderr, no traceback
     for bad in ("data=", "data=3", "date=8"):
