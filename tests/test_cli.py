@@ -285,6 +285,39 @@ def test_cli_mesh_flag_end_to_end(ws, tmp_path):
         assert exc.value.code == 2, bad
 
 
+def test_cli_evaluate_jsonl_stream_matches_json(ws, tmp_path):
+    """The docs/full_corpus.md recipe: evaluating a ``.jsonl`` stream
+    (the 1.2M-report format) through the CLI must produce the same
+    metrics as the equivalent ``.json`` corpus."""
+    config = tiny_memory_config(ws)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+
+    samples = json.loads(Path(ws["paths"]["test"]).read_text())
+    stream = tmp_path / "test_stream.jsonl"
+    stream.write_text("\n".join(json.dumps(s) for s in samples))
+
+    overrides = json.dumps({"evaluation": {"batch_size": 8, "max_length": 48}})
+    rc = main(["evaluate", str(ser_dir), ws["paths"]["test"],
+               "-o", str(tmp_path / "ev_json"), "--name", "memvul",
+               "--no-mesh", "--overrides", overrides])
+    assert rc == 0
+    rc = main(["evaluate", str(ser_dir), str(stream),
+               "-o", str(tmp_path / "ev_jsonl"), "--name", "memvul",
+               "--no-mesh", "--overrides", overrides])
+    assert rc == 0
+    m_json = json.loads(
+        (tmp_path / "ev_json" / "memvul_metric_all.json").read_text()
+    )
+    m_jsonl = json.loads(
+        (tmp_path / "ev_jsonl" / "memvul_metric_all.json").read_text()
+    )
+    for key in ("TP", "FN", "TN", "FP", "f1", "auc"):
+        assert m_jsonl[key] == pytest.approx(m_json[key], abs=1e-6), key
+
+
 def test_cli_evaluate_golden_file_swaps_anchor_bank(ws, tmp_path):
     """--golden-file replaces the archive config's anchor bank at eval
     time (reference: predict_memory.py's golden file argument) — the
