@@ -285,6 +285,36 @@ def test_cli_mesh_flag_end_to_end(ws, tmp_path):
         assert exc.value.code == 2, bad
 
 
+def test_cli_evaluate_threshold_flag_reaches_metrics(ws, tmp_path):
+    """--threshold carries the validation-chosen decision threshold into
+    cal_metrics (reference: predict_memory.py thres argument); the
+    metric file must record it and the confusion counts must respond."""
+    config = tiny_memory_config(ws)
+    cfg_path = tmp_path / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = tmp_path / "out"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+
+    overrides = json.dumps({"evaluation": {"batch_size": 8, "max_length": 48}})
+    for thres in ("0.1", "0.9"):
+        out = tmp_path / f"ev_{thres}"
+        rc = main(["evaluate", str(ser_dir), ws["paths"]["test"],
+                   "-o", str(out), "--name", "memvul", "--no-mesh",
+                   "--threshold", thres, "--overrides", overrides])
+        assert rc == 0
+        m = json.loads((out / "memvul_metric_all.json").read_text())
+        assert m["thres"] == float(thres)
+        # falsifiable: TP+FP must equal the number of reports whose
+        # max-over-anchors score clears THIS threshold, recomputed
+        # independently from the result records — a vote decoupled from
+        # the recorded threshold fails here
+        expected_pos = 0
+        for line in (out / "memvul_result.json").read_text().splitlines():
+            for rec in json.loads(line):
+                expected_pos += max(rec["predict"].values()) >= float(thres)
+        assert m["TP"] + m["FP"] == expected_pos, thres
+
+
 def test_cli_evaluate_jsonl_stream_matches_json(ws, tmp_path):
     """The docs/full_corpus.md recipe: evaluating a ``.jsonl`` stream
     (the 1.2M-report format) through the CLI must produce the same
