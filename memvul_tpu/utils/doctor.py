@@ -22,8 +22,6 @@ front-loads every such check into one JSON report:
 
 from __future__ import annotations
 
-import json
-import os
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
