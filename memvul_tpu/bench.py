@@ -35,7 +35,14 @@ BENCH_QUANT (int8_dynamic — route dense contractions through the MXU's
 int8 path; same params, numerics bounded by the quantdrift proof),
 BENCH_MODEL (base | tiny — tiny is plumbing-validation only),
 BENCH_INFLIGHT (async device dispatch depth, default 2),
-BENCH_PROFILE (dir — capture a jax.profiler trace of the timed pass).
+BENCH_PROFILE (dir — capture a jax.profiler trace of the timed pass),
+BENCH_MICRO (anchor_match — run the isolated bank-match microbench,
+fused Pallas kernel vs decomposed einsum, instead of the full scoring
+pass; BENCH_MICRO_{B,A,D,ITERS} set its shape),
+BENCH_PHASE_TIMEOUT (per-phase watchdog deadline inside the child,
+default 600 s, 0 disables — a stuck phase emits a parseable JSON
+failure record naming the phase and exits 124 fast instead of sitting
+silent until the external ``timeout`` kill; the supervisor retries it).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
@@ -54,6 +61,7 @@ never a bare traceback, and kills the child's whole process group so no
 stray process is left holding the TPU.
 """
 
+import contextlib
 import json
 import os
 import re
@@ -61,12 +69,15 @@ import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 BASELINE_RPS_512 = 190.0  # estimated GTX-3090 throughput at seq_len 512 (above)
 
 # Substrings marking a transient backend failure worth retrying (the
-# round-2 capture died with the first one).
+# round-2 capture died with the first one).  A watchdog phase-timeout is
+# retryable too: a phase that stops making progress mid-run is the
+# silently-wedged-backend signature, same as a hung first device op.
 _RETRYABLE_MARKERS = (
     "UNAVAILABLE",
     "Unable to initialize backend",
@@ -74,12 +85,87 @@ _RETRYABLE_MARKERS = (
     "ABORTED",
     "Socket closed",
     "failed to connect",
+    "watchdog: phase",
 )
 
 _CHILD_ENV_FLAG = "MEMVUL_BENCH_CHILD"
 
 
+def _metric_name() -> str:
+    micro = os.environ.get("BENCH_MICRO")
+    return f"{micro}_microbench" if micro else "siamese_scoring_throughput"
+
+
+class _PhaseWatchdog:
+    """Hard per-phase deadline inside the bench child.
+
+    The round-5 run died at the external ``timeout`` kill (rc=124) with
+    nothing on stdout: a wedged backend hung one device op for the whole
+    attempt budget and the only evidence was the driver's SIGKILL.  This
+    watchdog runs on a daemon thread, so when a phase (workspace build,
+    anchor encode, warmup, the timed pass) exceeds its deadline it can
+    still emit a parseable JSON failure record naming the stuck phase
+    and hard-exit 124 — even while the main thread is blocked inside a
+    device op that will never return.  ``os._exit`` (not sys.exit) is
+    deliberate: a wedged PJRT client may hang interpreter teardown too.
+
+    The record carries ``"error"``/``"watchdog_timeout"`` so the
+    supervisor's result extraction skips it and retries the attempt
+    (the marker is in ``_RETRYABLE_MARKERS``).
+    """
+
+    def __init__(self, timeout: float, metric: str):
+        self.timeout = timeout
+        self.metric = metric
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        if self.timeout <= 0:  # BENCH_PHASE_TIMEOUT=0 disables
+            yield
+            return
+        timer = threading.Timer(self.timeout, self._expire, args=(name,))
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+
+    def _expire(self, name: str) -> None:
+        record = {
+            "metric": self.metric,
+            "value": 0.0,
+            "unit": "reports/sec",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: phase {name!r} exceeded {self.timeout:.0f}s",
+            "phase": name,
+            "watchdog_timeout": True,
+        }
+        sys.stdout.write(json.dumps(record) + "\n")
+        sys.stdout.flush()
+        sys.stderr.write(
+            f"bench watchdog: phase {name!r} exceeded {self.timeout:.0f}s; "
+            "aborting attempt\n"
+        )
+        sys.stderr.flush()
+        os._exit(124)
+
+
+def _watchdog() -> _PhaseWatchdog:
+    return _PhaseWatchdog(
+        float(os.environ.get("BENCH_PHASE_TIMEOUT", "600")), _metric_name()
+    )
+
+
 def _run_bench() -> None:
+    if os.environ.get("BENCH_MICRO") == "anchor_match":
+        _run_anchor_match_micro()
+        return
+    if os.environ.get("BENCH_MICRO"):
+        raise ValueError(
+            f"unknown BENCH_MICRO mode {os.environ['BENCH_MICRO']!r} "
+            "(known: anchor_match)"
+        )
     import numpy as np
     import jax
 
@@ -93,6 +179,8 @@ def _run_bench() -> None:
     from memvul_tpu.data.readers import MemoryReader
     from memvul_tpu.evaluate.predict_memory import SiamesePredictor
     from memvul_tpu.models import BertConfig, MemoryModel
+
+    watchdog = _watchdog()
 
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
     # default flipped to auto-8 in round 5: simulating the REAL batcher
@@ -119,13 +207,14 @@ def _run_bench() -> None:
     n_reports = int(os.environ.get("BENCH_REPORTS", "32768"))
     n_anchors = 129  # reference external-memory size (utils.py:347)
 
-    ws = build_workspace(
-        tempfile.mkdtemp(),
-        seed=0,
-        num_projects=8,
-        reports_per_project=max(4, n_reports // 8),
-        realistic_lengths=True,
-    )
+    with watchdog.phase("workspace"):
+        ws = build_workspace(
+            tempfile.mkdtemp(),
+            seed=0,
+            num_projects=8,
+            reports_per_project=max(4, n_reports // 8),
+            realistic_lengths=True,
+        )
     # BENCH_MODEL=tiny swaps in the 2-layer test geometry so the FULL
     # child path (workspace → anchors → bucketed scoring → JSON line) can
     # be exercised off-TPU in seconds; the recorded number is only
@@ -160,7 +249,9 @@ def _run_bench() -> None:
         "input_ids": np.zeros((2, 8), np.int32),
         "attention_mask": np.ones((2, 8), np.int32),
     }
-    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    # first device op: where a wedged backend historically hangs
+    with watchdog.phase("model_init"):
+        params = model.init(jax.random.PRNGKey(0), dummy, dummy)
 
     reader = MemoryReader(
         cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
@@ -203,7 +294,10 @@ def _run_bench() -> None:
         instances.append(
             {"text1": text, "meta": {"label": f"{cat}#{i}", "type": "golden"}}
         )
-    predictor.encode_anchors(instances)
+    # includes the AOT shape warmup: every bucket program compiles here,
+    # not at its first mid-stream occurrence
+    with watchdog.phase("anchor_encode"):
+        predictor.encode_anchors(instances)
 
     inflight = int(os.environ.get("BENCH_INFLIGHT", "2"))
 
@@ -218,10 +312,12 @@ def _run_bench() -> None:
 
     from memvul_tpu.utils.profiling import trace_context
 
-    run_pass()  # warmup: compile (one program per bucket) + tokenizer cache
+    with watchdog.phase("warmup_pass"):
+        run_pass()  # warmup: tokenizer cache + any shape the AOT set missed
     # BENCH_PROFILE=<dir>: capture a jax.profiler trace of the timed pass
-    with trace_context(os.environ.get("BENCH_PROFILE")):
-        total, elapsed = run_pass()
+    with watchdog.phase("timed_pass"):
+        with trace_context(os.environ.get("BENCH_PROFILE")):
+            total, elapsed = run_pass()
     rps = total / elapsed
 
     # the baseline estimate is FLOP-derived at padded length 512 (the
@@ -259,8 +355,124 @@ def _run_bench() -> None:
     )
 
 
+def _run_anchor_match_micro() -> None:
+    """BENCH_MICRO=anchor_match: the bank-match op in isolation.
+
+    Times the fused Pallas anchor-match against the decomposed-einsum
+    XLA formulation at the production shape (B=512 reports × A=129
+    anchors × D=512, overridable via BENCH_MICRO_{B,A,D,ITERS}) and
+    prints one JSON line reporting both variants plus the analytic
+    HBM-traffic estimates the kernel exists to eliminate.
+
+    Off-TPU the "fused" variant measures what production dispatch
+    actually runs there — the jnp decomposition (``fused_backend`` says
+    so in the record); interpret-mode timings are meaningless and are
+    opt-in via BENCH_MICRO_INTERPRET=1 for kernel-logic smoke only.
+    """
+    from memvul_tpu.utils.platform import (
+        enable_compilation_cache,
+        honor_platform_env,
+        is_tpu_backend,
+    )
+
+    honor_platform_env()
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from memvul_tpu.ops.pallas.anchor_match import (
+        anchor_match_reference,
+        fused_anchor_match,
+    )
+
+    watchdog = _watchdog()
+    b = int(os.environ.get("BENCH_MICRO_B", "512"))
+    a = int(os.environ.get("BENCH_MICRO_A", "129"))
+    d = int(os.environ.get("BENCH_MICRO_D", "512"))
+    iters = int(os.environ.get("BENCH_MICRO_ITERS", "50"))
+    interpret = os.environ.get("BENCH_MICRO_INTERPRET") == "1"
+    c = 2
+
+    with watchdog.phase("micro_setup"):
+        on_tpu = is_tpu_backend()
+        dtype = jnp.bfloat16 if on_tpu else jnp.float32
+        rng = np.random.default_rng(0)
+        u = jax.device_put(jnp.asarray(rng.normal(size=(b, d)), dtype))
+        v = jax.device_put(jnp.asarray(rng.normal(size=(a, d)), dtype))
+        k = jax.device_put(jnp.asarray(rng.normal(size=(3 * d, c)) * 0.1, dtype))
+
+    if on_tpu or interpret:
+        fused_backend = "pallas-interpret" if not on_tpu else "pallas"
+        fused = jax.jit(
+            lambda u, v, k: fused_anchor_match(u, v, k, interpret=not on_tpu)
+        )
+        if interpret:
+            iters = min(iters, 2)  # interpret mode is orders slower
+    else:
+        # production dispatch on this backend IS the decomposition
+        fused_backend = "xla-fallback"
+        fused = jax.jit(anchor_match_reference)
+    decomposed = jax.jit(anchor_match_reference)
+
+    def rep(fn):
+        start = time.perf_counter()
+        for _ in range(iters):
+            out = fn(u, v, k)
+        out.block_until_ready()
+        return (time.perf_counter() - start) / iters
+
+    # compile + warm BOTH variants before timing either, then interleave
+    # the timed reps and keep each variant's best — a fresh process ramps
+    # thread pools/allocator over the first calls, which would otherwise
+    # be billed entirely to whichever variant ran first
+    with watchdog.phase("micro_compile"):
+        for fn in (decomposed, fused):
+            fn(u, v, k).block_until_ready()
+    with watchdog.phase("micro_timing"):
+        xla_s, fused_s = float("inf"), float("inf")
+        for _ in range(3):
+            xla_s = min(xla_s, rep(decomposed))
+            fused_s = min(fused_s, rep(fused))
+
+    # analytic HBM-traffic estimate: the decomposed path writes the
+    # [B, A, D] abs-diff then reads it back for the einsum; the fused
+    # path touches inputs once and the [B, A, C] logits once
+    sz = jnp.dtype(dtype).itemsize
+    io_bytes = (b * d + a * d + 3 * d * c) * sz + b * a * c * sz
+    bytes_decomposed = io_bytes + 2 * b * a * d * sz
+    print(
+        json.dumps(
+            {
+                "metric": "anchor_match_microbench",
+                "value": round(xla_s / fused_s, 3),
+                "unit": "x (decomposed_ms / fused_ms)",
+                "fused_ms": round(fused_s * 1e3, 4),
+                "decomposed_ms": round(xla_s * 1e3, 4),
+                "matches_per_s_fused": round(b * a / fused_s),
+                "matches_per_s_decomposed": round(b * a / xla_s),
+                "hbm_bytes_est": {
+                    "decomposed": bytes_decomposed,
+                    "fused": io_bytes,
+                    "ratio": round(bytes_decomposed / io_bytes, 1),
+                },
+                "config": {
+                    "B": b, "A": a, "D": d, "iters": iters,
+                    "dtype": str(jnp.dtype(dtype)),
+                    "fused_backend": fused_backend,
+                },
+            }
+        )
+    )
+
+
 def _extract_result_line(text: str):
-    """Last stdout line that parses as the bench result dict, else None."""
+    """Last stdout line that parses as the bench result dict, else None.
+
+    Records carrying an ``error`` field (the watchdog's phase-timeout
+    record) are NOT results — skipping them here is what lets the
+    supervisor retry a watchdog-killed attempt instead of reporting its
+    failure record as a measurement."""
     for line in reversed(text.splitlines()):
         line = line.strip()
         if not line.startswith("{"):
@@ -269,7 +481,7 @@ def _extract_result_line(text: str):
             obj = json.loads(line)
         except ValueError:
             continue
-        if isinstance(obj, dict) and "metric" in obj:
+        if isinstance(obj, dict) and "metric" in obj and "error" not in obj:
             return line
     return None
 
@@ -443,7 +655,7 @@ def main() -> int:
         print(
             json.dumps(
                 {
-                    "metric": "siamese_scoring_throughput",
+                    "metric": _metric_name(),
                     "value": 0.0,
                     "unit": "reports/sec",
                     "vs_baseline": 0.0,
@@ -460,7 +672,7 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": "siamese_scoring_throughput",
+                "metric": _metric_name(),
                 "value": 0.0,
                 "unit": "reports/sec",
                 "vs_baseline": 0.0,

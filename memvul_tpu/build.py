@@ -315,6 +315,7 @@ def evaluate_from_archive(
     test corpus, write ``{name}_result.json`` + ``{name}_metric_all.json``
     (reference: predict_memory.py:49-114,159-197)."""
     from .archive import load_archive
+    from .config import evaluation_config
 
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -323,9 +324,11 @@ def evaluate_from_archive(
     model_type = model_cfg.get("type", "model_memory")
     name = name or model_type
     reader = build_reader(arch.config.get("dataset_reader"))
-    eval_cfg = arch.config.get("evaluation") or {}
-    batch_size = int(eval_cfg.get("batch_size", 512))
-    max_length = int(eval_cfg.get("max_length", 512))
+    # the evaluation section merged over its documented defaults
+    # (config.EVALUATION_DEFAULTS) — null-tolerant in one place
+    eval_cfg = evaluation_config(arch.config)
+    batch_size = int(eval_cfg["batch_size"])
+    max_length = int(eval_cfg["max_length"])
     # overrides written for base geometry (max_length 512) must not crash
     # a smaller-position archive deep in the encoder — clamp to the
     # model's own position table
@@ -339,7 +342,7 @@ def evaluate_from_archive(
             max_length, model_positions,
         )
         max_length = model_positions
-    buckets = eval_cfg.get("buckets")
+    buckets = eval_cfg["buckets"]
     if buckets == "auto":
         # padding-minimizing DP boundaries from a corpus length sample —
         # the same optimizer (and the same n=8 default) the bench uses
@@ -351,18 +354,15 @@ def evaluate_from_archive(
             arch.tokenizer,
             test_path,
             max_length,
-            n_buckets=int(eval_cfg.get("n_buckets", 8)),
+            n_buckets=int(eval_cfg["n_buckets"]),
         )
         logger.info("auto buckets for %s: %s", test_path, buckets)
     elif buckets is not None:
         buckets = [int(b) for b in buckets]
-    tokens_per_batch = eval_cfg.get("tokens_per_batch")
+    tokens_per_batch = eval_cfg["tokens_per_batch"]
     if tokens_per_batch is not None:
         tokens_per_batch = int(tokens_per_batch)
-    # null-tolerant like tokens_per_batch, but 0 is a real value (fully
-    # synchronous dispatch) and must survive
-    _inflight_cfg = eval_cfg.get("inflight")
-    inflight = 2 if _inflight_cfg is None else int(_inflight_cfg)
+    inflight = int(eval_cfg["inflight"])
 
     out_results = out_dir / f"{name}_result.json"
     out_metrics = out_dir / f"{name}_metric_all.json"
@@ -391,6 +391,8 @@ def evaluate_from_archive(
             tokens_per_batch=tokens_per_batch,
             thres=thres,
             inflight=inflight,
+            anchor_match_impl=eval_cfg["anchor_match_impl"],
+            aot_warmup=bool(eval_cfg["aot_warmup"]),
         )
     from .evaluate.predict_single import test_single
 

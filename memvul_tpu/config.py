@@ -217,3 +217,42 @@ def _deep_merge(node: Dict[str, Any], overrides: Dict[str, Any]) -> None:
 
 def save_config(cfg: Dict[str, Any], path: Union[str, Path]) -> None:
     Path(path).write_text(json.dumps(cfg, indent=2, sort_keys=False))
+
+
+# The ``evaluation`` config section, with its documented defaults.  The
+# eval entry points (build.evaluate_from_archive) read this one merged
+# view instead of scattering per-key ``.get`` defaults, so a new knob is
+# added exactly once.  ``None`` means "feature off / model default".
+EVALUATION_DEFAULTS: Dict[str, Any] = {
+    "batch_size": 512,       # rows per batch without a token budget
+    "max_length": 512,       # token cap (clamped to the model's positions)
+    "buckets": None,         # length-bin boundaries; "auto" derives them
+    "n_buckets": 8,          # boundary count for "auto" buckets
+    "tokens_per_batch": None,  # constant token budget per batch
+    "inflight": 2,           # async device dispatch depth (0 = sync)
+    "anchor_match_impl": None,  # None → model config ("auto"|"fused"|"xla")
+    "aot_warmup": True,      # precompile every stream shape at startup
+}
+
+
+def evaluation_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``cfg["evaluation"]`` merged over :data:`EVALUATION_DEFAULTS`.
+
+    Explicit JSON ``null`` values fall back to the default (matching the
+    existing null-tolerant handling of ``tokens_per_batch``/``inflight``;
+    0 and "" are real values and survive).  Unknown keys are kept — they
+    may belong to a newer reader — but logged so a typo like
+    ``"ancor_match_impl"`` doesn't silently disable a feature.
+    """
+    import logging
+
+    section = dict((cfg or {}).get("evaluation") or {})
+    unknown = sorted(set(section) - set(EVALUATION_DEFAULTS))
+    if unknown:
+        logging.getLogger(__name__).warning(
+            "evaluation config: unknown key(s) %s (known: %s)",
+            unknown, sorted(EVALUATION_DEFAULTS),
+        )
+    out = dict(EVALUATION_DEFAULTS)
+    out.update({k: v for k, v in section.items() if v is not None})
+    return out

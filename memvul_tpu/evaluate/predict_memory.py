@@ -53,6 +53,8 @@ class SiamesePredictor:
         buckets: Optional[Sequence[int]] = None,
         tokens_per_batch: Optional[int] = None,
         anchor_chunk: int = 128,
+        anchor_match_impl: Optional[str] = None,
+        aot_warmup: bool = True,
     ) -> None:
         self.model = model
         self.mesh = mesh
@@ -72,15 +74,36 @@ class SiamesePredictor:
         self.anchor_bank = None  # [A(+pad), D] device array
         self.n_anchors = 0  # real (unpadded) bank size
         self.anchor_labels: List[str] = []
+        n_model = mesh.shape.get(MODEL_AXIS, 1) if mesh is not None else 1
+        if n_model > 1 and anchor_match_impl not in (None, "xla"):
+            # a model-sharded bank needs XLA's SPMD partitioner to split
+            # the |u−v| contraction over the mesh; the Pallas kernel has
+            # no sharded lowering, so the fused path is forced off
+            logger.info(
+                "anchor bank is model-sharded (×%d): forcing "
+                "anchor_match_impl='xla' (was %r)", n_model, anchor_match_impl,
+            )
+        self.anchor_match_impl = "xla" if n_model > 1 else anchor_match_impl
+        self.aot_warmup = aot_warmup
+        # compile-count probe: increments only when jit misses its cache
+        # and traces (once per batch shape) — after warmup_compile() it
+        # must stay flat for every shape in the bucket set
+        self.score_trace_count = 0
 
         self._encode_fn = jax.jit(
             lambda p, b: self.model.apply(p, b, deterministic=True)
         )
-        self._score_fn = jax.jit(
-            lambda p, b, bank: anchor_probs(
-                self.model.apply(p, b, anchors=bank, deterministic=True)
+
+        def _score(p, b, bank):
+            self.score_trace_count += 1  # host-side, runs at trace only
+            return anchor_probs(
+                self.model.apply(
+                    p, b, anchors=bank, deterministic=True,
+                    anchor_impl=self.anchor_match_impl,
+                )
             )
-        )
+
+        self._score_fn = jax.jit(_score)
 
     # -- phase 1: anchor bank ------------------------------------------------
 
@@ -135,6 +158,51 @@ class SiamesePredictor:
             "anchor bank: %d anchors (%d padded), dim %d, model-sharding ×%d",
             self.n_anchors, bank.shape[0] - self.n_anchors, bank.shape[1], n_model,
         )
+        if self.aot_warmup:
+            self.warmup_compile()
+
+    # -- phase 1.5: AOT shape warmup -----------------------------------------
+
+    def stream_shapes(self) -> List[Tuple[int, int]]:
+        """The closed (rows, seq_len) shape set streaming can produce.
+
+        With buckets every batch is one of the bucket lengths at its
+        fixed row count (tails are dead-row padded to the same shape);
+        without buckets everything pads to (batch_size, max_length)."""
+        if self.buckets is None:
+            return [(self.batch_size, self.encoder.max_length)]
+        sizes = self.bucket_sizes or {b: self.batch_size for b in self.buckets}
+        return [(sizes[b], b) for b in self.buckets]
+
+    def warmup_compile(self) -> int:
+        """AOT-precompile the score program for every stream shape.
+
+        XLA compiles one program per input shape, and at base geometry a
+        compile is multi-second; without warmup the first occurrence of
+        each bucket shape mid-stream stalls the inflight pipeline behind
+        it.  ``jit(...).lower(...).compile()`` populates the same
+        executable cache the streaming calls hit, so after this returns
+        no shape in the bucket set can trigger a mid-stream compile
+        (asserted via the ``score_trace_count`` probe in tests).
+        Returns the number of shapes compiled.
+        """
+        if self.anchor_bank is None:
+            raise RuntimeError("call encode_anchors() first")
+        shapes = self.stream_shapes()
+        start = time.perf_counter()
+        for rows, length in shapes:
+            sample = {
+                "input_ids": np.zeros((rows, length), np.int32),
+                "attention_mask": np.ones((rows, length), np.int32),
+            }
+            if self.mesh is not None:
+                sample = shard_batch(sample, self.mesh)
+            self._score_fn.lower(self.params, sample, self.anchor_bank).compile()
+        logger.info(
+            "AOT warmup: %d score program(s) %s compiled in %.1fs",
+            len(shapes), shapes, time.perf_counter() - start,
+        )
+        return len(shapes)
 
     # -- phase 2: streaming scoring ------------------------------------------
 
@@ -297,6 +365,8 @@ def test_siamese(
     tokens_per_batch: Optional[int] = None,
     thres: float = 0.5,
     inflight: int = 2,
+    anchor_match_impl: Optional[str] = None,
+    aot_warmup: bool = True,
 ) -> Dict[str, float]:
     """End-to-end evaluation mirroring the reference's ``test_siamese``
     (predict_memory.py:49-114) + ``cal_metrics`` (:159-197)."""
@@ -312,6 +382,8 @@ def test_siamese(
         max_length=max_length,
         buckets=buckets,
         tokens_per_batch=tokens_per_batch,
+        anchor_match_impl=anchor_match_impl,
+        aot_warmup=aot_warmup,
     )
     predictor.encode_anchors(reader.read_anchors(str(golden_file)))
     eval_metrics = predictor.predict_file(

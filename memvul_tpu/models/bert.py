@@ -52,6 +52,10 @@ class BertConfig:
     # checkpoints — quantization is a property of the forward).  None =
     # full precision
     quant: Optional[str] = None
+    # bank-match backend for MemoryModel.match_anchors: "auto" runs the
+    # fused Pallas kernel on TPU hardware and the jnp decomposition
+    # elsewhere; "fused" / "xla" pin a backend (ops/pallas/anchor_match)
+    anchor_match_impl: str = "auto"
 
     @classmethod
     def tiny(cls, vocab_size: int = 2048, **kw) -> "BertConfig":

@@ -87,20 +87,25 @@ class MemoryModel(nn.Module):
         features = jnp.concatenate([u, v, jnp.abs(u - v)], axis=-1)
         return features @ self.pair_kernel.astype(features.dtype)
 
-    def match_anchors(self, u: jax.Array, anchors: jax.Array) -> jax.Array:
+    def match_anchors(
+        self, u: jax.Array, anchors: jax.Array, impl: Optional[str] = None
+    ) -> jax.Array:
         """[B, D] × [A, D] → logits [B, A, 2] against the full bank.
 
-        Decomposes the concat-linear so no [B, A, 3D] tensor is built:
-        only the |u-v| term needs a [B, A, D] intermediate.
+        Decomposes the concat-linear so no [B, A, 3D] tensor is built;
+        the backend for the remaining |u-v| contraction comes from
+        ``config.anchor_match_impl`` (or the per-call ``impl`` override):
+        on TPU the fused Pallas kernel streams the [B, A, D] intermediate
+        through VMEM so it never touches HBM; elsewhere (and for a
+        model-sharded bank) the jnp decomposition runs
+        (ops/pallas/anchor_match.py).
         """
-        d = u.shape[-1]
+        from ..ops.pallas.anchor_match import anchor_match
+
         kernel = self.pair_kernel.astype(u.dtype)
-        w_u, w_v, w_d = kernel[:d], kernel[d : 2 * d], kernel[2 * d :]
-        term_u = u @ w_u  # [B, 2]
-        term_v = anchors @ w_v  # [A, 2]
-        diff = jnp.abs(u[:, None, :] - anchors[None, :, :])  # [B, A, D]
-        term_d = jnp.einsum("bad,dc->bac", diff, w_d)
-        return term_u[:, None, :] + term_v[None, :, :] + term_d
+        return anchor_match(
+            u, anchors, kernel, impl=impl or self.config.anchor_match_impl
+        )
 
     def __call__(
         self,
@@ -108,12 +113,15 @@ class MemoryModel(nn.Module):
         sample2=None,
         anchors: Optional[jax.Array] = None,
         deterministic: bool = True,
+        anchor_impl: Optional[str] = None,
     ):
         """Training: (sample1, sample2) → pair logits [B, 2].
-        Inference: (sample1, anchors=[A, D]) → anchor logits [B, A, 2]."""
+        Inference: (sample1, anchors=[A, D]) → anchor logits [B, A, 2].
+        ``anchor_impl`` overrides ``config.anchor_match_impl`` per call
+        (the predictor forces "xla" when the bank is model-sharded)."""
         u = self.encode(sample1, deterministic=deterministic)
         if anchors is not None:
-            return self.match_anchors(u, anchors)
+            return self.match_anchors(u, anchors, impl=anchor_impl)
         if sample2 is None:
             return u
         v = self.encode(sample2, deterministic=deterministic)

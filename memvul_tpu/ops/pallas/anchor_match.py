@@ -1,0 +1,259 @@
+"""Pallas TPU kernel for the fused anchor-bank match.
+
+The Siamese bank match (models/memory.py:match_anchors) decomposes the
+bias-free concat-linear into
+
+    logits[b, a, c] = u[b]·W_u[:, c] + v[a]·W_v[:, c]
+                      + Σ_d |u[b, d] − v[a, d]| · W_d[d, c]
+
+Two small matmuls plus one batched abs-diff contraction.  XLA fuses the
+matmuls but materializes the ``[B, A, D]`` abs-diff intermediate in HBM
+— at the production shape (B=512, A=129, D=512, bf16) that is ~68 MB
+written by the subtraction and read back by the einsum, per batch, for
+an op whose useful inputs total under 1 MB.  The corpus-scoring path is
+the north-star workload (1.2M reports streamed against the bank), so
+that round-trip is pure memory-bound overhead — the same pattern the
+flash-attention kernel (flash_kernel.py) eliminates for the [Tq, Tk]
+score matrix.
+
+:func:`fused_anchor_match` streams the reduction instead: the grid tiles
+(B, A) and walks D blockwise, so each ``[block_b, block_a, block_d]``
+abs-diff tile lives only in VMEM/registers and HBM traffic drops to the
+inputs-once + output (see docs/anchor_match_kernel.md for the math).
+The u/v terms are folded into the same D-walk, so the kernel emits the
+complete logits — no separate XLA epilogue.
+
+Layout notes (mirroring flash_kernel.py):
+
+* the output is produced as ``[C, B, A]`` — the class dim (C=2) is far
+  below the 128-lane tile, so it rides in the leading (freely blockable)
+  position while the last two block dims stay (8, 128)-aligned; the
+  caller transposes back to ``[B, A, C]``;
+* the three weight slices arrive pre-transposed as ``[C, D]`` rows so a
+  class's weight vector is a lane-contiguous row inside the kernel;
+* scores accumulate in float32 scratch regardless of input dtype
+  (bf16-safe), output casts back to the input dtype;
+* ``interpret=True`` runs the same kernel logic on CPU — that is the
+  path the parity tests exercise (tests/test_anchor_match_kernel.py);
+  ``interpret=None`` resolves to interpret-off-TPU like flash_attention.
+
+:func:`anchor_match` is the dispatch used by the model: ``"auto"``
+routes to the kernel on TPU hardware and to the jnp decomposition
+(:func:`anchor_match_reference`) everywhere else — interpret mode is a
+debugging/testing vehicle, not a CPU production path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# renamed TPUCompilerParams -> CompilerParams across jax releases
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
+def anchor_match_reference(
+    u: jax.Array, anchors: jax.Array, kernel: jax.Array
+) -> jax.Array:
+    """[B, D] × [A, D] × [3D, C] → [B, A, C] via the decomposed einsum.
+
+    This is the XLA formulation (the pre-kernel ``match_anchors`` body):
+    only the |u−v| term builds a [B, A, D] intermediate.  It is the
+    numerical reference for the Pallas kernel and the fallback on
+    non-TPU backends and for a model-sharded anchor bank, where XLA's
+    SPMD partitioner splits the einsum across the mesh.
+    """
+    d = u.shape[-1]
+    w_u, w_v, w_d = kernel[:d], kernel[d : 2 * d], kernel[2 * d :]
+    term_u = u @ w_u  # [B, C]
+    term_v = anchors @ w_v  # [A, C]
+    diff = jnp.abs(u[:, None, :] - anchors[None, :, :])  # [B, A, D]
+    term_d = jnp.einsum("bad,dc->bac", diff, w_d)
+    return term_u[:, None, :] + term_v[None, :, :] + term_d
+
+
+def _fit_block(block: int, t: int, floor: int) -> int:
+    """Largest block ≤ the requested size whose grid padding stays ≤25%
+    (same policy as flash_kernel._fit_block, with a per-dim floor: 8 for
+    sublane-tiled dims, 128 for lane-tiled ones)."""
+    block = max(min(block, -(-t // floor) * floor), floor)
+    while block > floor and -(-t // block) * block > 1.25 * t:
+        block = max(block // 2, floor)
+    return block
+
+
+def _anchor_match_kernel(
+    u_ref,    # [block_b, block_d]
+    v_ref,    # [block_a, block_d]
+    wu_ref,   # [C, block_d]  (pre-transposed weight rows)
+    wv_ref,   # [C, block_d]
+    wd_ref,   # [C, block_d]
+    out_ref,  # [C, block_b, block_a]
+    acc_ref,  # [C, block_b, block_a] f32 scratch
+    *,
+    num_d_blocks: int,
+    num_classes: int,
+):
+    dj = pl.program_id(2)
+
+    @pl.when(dj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    u = u_ref[...].astype(jnp.float32)  # [block_b, block_d]
+    v = v_ref[...].astype(jnp.float32)  # [block_a, block_d]
+    # the tile that never touches HBM: |u − v| for this (B, A, D) block
+    diff = jnp.abs(u[:, None, :] - v[None, :, :])  # [block_b, block_a, block_d]
+    for c in range(num_classes):  # static unroll, C == 2
+        w_d = wd_ref[c, :].astype(jnp.float32)  # [block_d]
+        w_u = wu_ref[c, :].astype(jnp.float32)
+        w_v = wv_ref[c, :].astype(jnp.float32)
+        # VPU reductions over the lane (d) axis; each is a partial sum
+        # over this d-block, so accumulating per grid step stays exact
+        term_d = jnp.sum(diff * w_d[None, None, :], axis=-1)  # [block_b, block_a]
+        term_u = jnp.sum(u * w_u[None, :], axis=-1)  # [block_b]
+        term_v = jnp.sum(v * w_v[None, :], axis=-1)  # [block_a]
+        acc_ref[c, :, :] += term_d + term_u[:, None] + term_v[None, :]
+
+    @pl.when(dj == num_d_blocks - 1)
+    def _finalize():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+def fused_anchor_match(
+    u: jax.Array,
+    anchors: jax.Array,
+    kernel: jax.Array,
+    block_b: int = 128,
+    block_a: int = 128,
+    block_d: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """[B, D] × [A, D] × [3D, C] → [B, A, C] without the HBM intermediate.
+
+    Grid: (B/block_b, A/block_a) parallel tiles × a D-blockwise reduction
+    walked innermost ("arbitrary"), flash-attention style.  All three
+    operands are zero-padded up to block multiples — zero d-columns
+    contribute exactly zero to every term (|0−0| = 0 and the padded
+    weight rows are zero), and padded B/A rows are sliced off the output.
+
+    ``interpret`` defaults to True off-TPU so the kernel logic is
+    testable anywhere (the dispatch in :func:`anchor_match` routes
+    non-TPU *production* calls to the jnp reference instead — interpret
+    mode is orders of magnitude slower than XLA on CPU).
+    """
+    if u.ndim != 2 or anchors.ndim != 2 or kernel.ndim != 2:
+        raise ValueError(
+            f"expected u[B, D], anchors[A, D], kernel[3D, C]; got "
+            f"{u.shape}, {anchors.shape}, {kernel.shape}"
+        )
+    b, d = u.shape
+    a = anchors.shape[0]
+    if anchors.shape[1] != d or kernel.shape[0] != 3 * d:
+        raise ValueError(
+            f"dimension mismatch: u D={d}, anchors D={anchors.shape[1]}, "
+            f"kernel rows={kernel.shape[0]} (need 3D={3 * d})"
+        )
+    c = kernel.shape[1]
+    if interpret is None:
+        from ...utils.platform import is_tpu_backend
+
+        interpret = not is_tpu_backend()
+
+    # weight slices as [C, D] rows: lane-contiguous per class in-kernel
+    w_u = kernel[:d].T
+    w_v = kernel[d : 2 * d].T
+    w_d = kernel[2 * d :].T
+
+    block_b = _fit_block(block_b, b, floor=8)
+    block_a = _fit_block(block_a, a, floor=128)
+    block_d = _fit_block(block_d, d, floor=128)
+    pad_b, pad_a, pad_d = (-b) % block_b, (-a) % block_a, (-d) % block_d
+    if pad_b or pad_d:
+        u = jnp.pad(u, ((0, pad_b), (0, pad_d)))
+    if pad_a or pad_d:
+        anchors = jnp.pad(anchors, ((0, pad_a), (0, pad_d)))
+    if pad_d:
+        w_u = jnp.pad(w_u, ((0, 0), (0, pad_d)))
+        w_v = jnp.pad(w_v, ((0, 0), (0, pad_d)))
+        w_d = jnp.pad(w_d, ((0, 0), (0, pad_d)))
+    bp, ap, dp = b + pad_b, a + pad_a, d + pad_d
+    num_d_blocks = dp // block_d
+
+    kern = functools.partial(
+        _anchor_match_kernel, num_d_blocks=num_d_blocks, num_classes=c
+    )
+    weight_spec = pl.BlockSpec(
+        (c, block_d), lambda bi, ai, dj: (0, dj), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=(bp // block_b, ap // block_a, num_d_blocks),
+        in_specs=[
+            pl.BlockSpec(
+                (block_b, block_d), lambda bi, ai, dj: (bi, dj),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_a, block_d), lambda bi, ai, dj: (ai, dj),
+                memory_space=pltpu.VMEM,
+            ),
+            weight_spec,
+            weight_spec,
+            weight_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (c, block_b, block_a), lambda bi, ai, dj: (0, bi, ai),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((c, bp, ap), u.dtype),
+        scratch_shapes=[pltpu.VMEM((c, block_b, block_a), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(u, anchors, w_u, w_v, w_d)
+
+    out = out.transpose(1, 2, 0)  # [C, Bp, Ap] -> [Bp, Ap, C]
+    if pad_b or pad_a:
+        out = out[:b, :a]
+    return out
+
+
+def anchor_match(
+    u: jax.Array,
+    anchors: jax.Array,
+    kernel: jax.Array,
+    impl: Optional[str] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Bank-match dispatch — the single entry point the model calls.
+
+    * ``"auto"`` (default, also ``None``): the Pallas kernel on real TPU
+      hardware, the jnp decomposition everywhere else;
+    * ``"fused"``: always the kernel (interpret mode off-TPU — the
+      testing path);
+    * ``"xla"``: always the jnp decomposition (also the forced choice
+      for a model-sharded anchor bank, where the SPMD partitioner must
+      split the contraction — see SiamesePredictor).
+    """
+    if impl is None or impl == "auto":
+        from ...utils.platform import is_tpu_backend
+
+        use_fused = is_tpu_backend()
+    elif impl == "fused":
+        use_fused = True
+    elif impl == "xla":
+        use_fused = False
+    else:
+        raise ValueError(
+            f"unknown anchor_match impl {impl!r} (want auto | fused | xla)"
+        )
+    if use_fused:
+        return fused_anchor_match(u, anchors, kernel, interpret=interpret)
+    return anchor_match_reference(u, anchors, kernel)

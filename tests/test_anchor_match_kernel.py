@@ -1,0 +1,184 @@
+"""Fused Pallas anchor-match kernel vs its XLA formulations.
+
+Runs the kernel logic in Pallas interpret mode on CPU (the identical
+code path compiles on TPU; the ``BENCH_MICRO=anchor_match`` harness
+records the on-hardware datapoint).  Three-way parity is required:
+
+* the fused kernel,
+* the decomposed einsum (``anchor_match_reference`` — the production
+  non-TPU path and the model-sharded-bank path),
+* the naive ``[u, v, |u−v|]`` concat-linear (the reference semantics,
+  model_memory.py:150-158),
+
+including odd (non-multiple-of-tile) B/A/D shapes that exercise the
+kernel's internal zero-padding, bf16 inputs, and dispatch behavior.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from memvul_tpu.ops.pallas.anchor_match import (
+    anchor_match,
+    anchor_match_reference,
+    fused_anchor_match,
+)
+
+
+def _inputs(b, a, d, c=2, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    u = jnp.asarray(rng.normal(size=(b, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(a, d)), dtype)
+    kernel = jnp.asarray(rng.normal(size=(3 * d, c)) * 0.1, dtype)
+    return u, v, kernel
+
+
+def _naive_concat(u, v, kernel):
+    """The reference's per-anchor concat-linear, one anchor at a time."""
+    rows = []
+    for i in range(v.shape[0]):
+        feats = jnp.concatenate(
+            [u, jnp.broadcast_to(v[i], u.shape), jnp.abs(u - v[i])], axis=-1
+        )
+        rows.append(feats @ kernel)
+    return jnp.stack(rows, axis=1)  # [B, A, C]
+
+
+@pytest.mark.parametrize(
+    "b,a,d",
+    [
+        (4, 6, 32),      # everything under one tile
+        (9, 13, 40),     # odd everywhere
+        (17, 129, 200),  # A just past a lane tile, D non-multiple
+        (130, 5, 96),    # B past a block, tiny A
+    ],
+)
+def test_fused_matches_both_formulations(b, a, d):
+    u, v, kernel = _inputs(b, a, d, seed=b + a + d)
+    fused = fused_anchor_match(u, v, kernel, interpret=True)
+    ref = anchor_match_reference(u, v, kernel)
+    naive = _naive_concat(u, v, kernel)
+    assert fused.shape == (b, a, 2)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(naive), atol=1e-4, rtol=1e-4)
+
+
+def test_fused_non_default_class_count():
+    u, v, kernel = _inputs(5, 7, 64, c=3, seed=7)
+    fused = fused_anchor_match(u, v, kernel, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(anchor_match_reference(u, v, kernel)),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_fused_bf16_close_to_fp32_reference():
+    u, v, kernel = _inputs(8, 9, 128, seed=3, dtype=jnp.bfloat16)
+    fused = fused_anchor_match(u, v, kernel, interpret=True)
+    ref = anchor_match_reference(
+        u.astype(jnp.float32), v.astype(jnp.float32), kernel.astype(jnp.float32)
+    )
+    assert fused.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(fused, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2
+    )
+
+
+def test_fused_rejects_mismatched_shapes():
+    u, v, kernel = _inputs(4, 5, 32)
+    with pytest.raises(ValueError, match="dimension mismatch"):
+        fused_anchor_match(u, v, kernel[:-1], interpret=True)
+    with pytest.raises(ValueError, match="expected"):
+        fused_anchor_match(u[None], v, kernel, interpret=True)
+
+
+def test_dispatch_impls():
+    u, v, kernel = _inputs(4, 5, 32, seed=11)
+    ref = anchor_match_reference(u, v, kernel)
+    # auto on CPU routes to the jnp decomposition (bit-identical)
+    auto = anchor_match(u, v, kernel, impl="auto")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(ref))
+    # fused off-TPU runs the interpret kernel — numerically equal
+    fused = anchor_match(u, v, kernel, impl="fused")
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="unknown anchor_match impl"):
+        anchor_match(u, v, kernel, impl="einsum")
+
+
+def test_model_match_anchors_fused_config_matches_default():
+    """MemoryModel wired to the fused kernel produces the same anchor
+    logits as the default decomposition (the config flag changes the
+    backend, never the scores)."""
+    from memvul_tpu.models import BertConfig, MemoryModel
+
+    def logits_for(impl):
+        cfg = BertConfig.tiny(vocab_size=256, anchor_match_impl=impl)
+        model = MemoryModel(cfg)
+        batch = {
+            "input_ids": np.arange(48, dtype=np.int32).reshape(4, 12) % 256,
+            "attention_mask": np.ones((4, 12), np.int32),
+        }
+        params = model.init(jax.random.PRNGKey(0), batch, batch)
+        anchors = jax.random.normal(jax.random.PRNGKey(1), (7, 512))
+        return model.apply(params, batch, anchors=anchors)
+
+    # "fused" runs the interpret kernel on CPU; "xla" the decomposition
+    np.testing.assert_allclose(
+        np.asarray(logits_for("fused")), np.asarray(logits_for("xla")),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_model_sharded_bank_forces_xla_and_matches(tmp_path):
+    """With the anchor bank sharded over the ``model`` mesh axis the
+    predictor must force the XLA decomposition (the kernel has no SPMD
+    lowering) — and the scores must match the unsharded fused-config
+    run exactly (rtol: different reduction orders)."""
+    from memvul_tpu.data.readers import MemoryReader
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+    from memvul_tpu.models import BertConfig, MemoryModel
+    from memvul_tpu.parallel import create_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual 8-device CPU mesh")
+    ws = build_workspace(tmp_path, seed=5)
+    cfg = BertConfig.tiny(
+        vocab_size=ws["tokenizer"].vocab_size, anchor_match_impl="fused"
+    )
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    mesh = create_mesh({"data": 2, "model": 2}, devices=jax.devices()[:4])
+    pred_sharded = SiamesePredictor(
+        model, params, ws["tokenizer"], mesh=mesh, batch_size=8, max_length=64
+    )
+    # the model-sharded bank overrides the configured fused path
+    assert pred_sharded.anchor_match_impl == "xla"
+    pred_plain = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=8, max_length=64,
+        anchor_match_impl="xla",
+    )
+    results = {}
+    for name, pred in [("sharded", pred_sharded), ("plain", pred_plain)]:
+        pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+        rows = {}
+        for probs, metas in pred.score_instances(
+            reader.read(ws["paths"]["test"], split="test")
+        ):
+            for row, meta in zip(probs, metas):
+                rows[meta["Issue_Url"]] = row
+        results[name] = rows
+    assert results["sharded"].keys() == results["plain"].keys()
+    for url, row in results["plain"].items():
+        np.testing.assert_allclose(
+            results["sharded"][url], row, rtol=1e-4, atol=1e-5
+        )

@@ -11,6 +11,7 @@ children (bounded budgets keep them fast either way).
 
 import json
 import os
+import subprocess
 import sys
 
 from memvul_tpu.bench import _extract_result_line, _supervise, _wait_for_device
@@ -29,6 +30,78 @@ def test_extract_result_line_picks_last_json_dict():
     assert _extract_result_line("no json here") is None
     # a JSON line without 'metric' is not a result
     assert _extract_result_line('{"foo": 1}') is None
+
+
+def test_extract_result_line_skips_error_records():
+    """The watchdog's phase-timeout record carries 'metric' (so drivers
+    parsing the stream still recognize it) but must NOT be mistaken for
+    a successful measurement; a real result before it still wins."""
+    watchdog = json.dumps(
+        {"metric": "siamese_scoring_throughput", "value": 0.0,
+         "error": "watchdog: phase 'timed_pass' exceeded 600s",
+         "watchdog_timeout": True}
+    )
+    assert _extract_result_line(watchdog) is None
+    assert _extract_result_line(RESULT + "\n" + watchdog) == RESULT
+
+
+def test_phase_watchdog_emits_record_and_exits_124():
+    """A phase that stops making progress: the watchdog thread emits one
+    parseable JSON failure record naming the phase and hard-exits 124 —
+    even though the 'stuck op' (sleep) never returns.  Run in a child
+    because the watchdog's os._exit would take pytest down with it."""
+    body = (
+        "import time\n"
+        "from memvul_tpu.bench import _PhaseWatchdog\n"
+        "wd = _PhaseWatchdog(0.3, 'siamese_scoring_throughput')\n"
+        "with wd.phase('timed_pass'):\n"
+        "    time.sleep(30)\n"
+        "print('UNREACHABLE')\n"
+    )
+    proc = subprocess.run(
+        _script_cmd(body), capture_output=True, text=True, timeout=25
+    )
+    assert proc.returncode == 124
+    assert "UNREACHABLE" not in proc.stdout
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["watchdog_timeout"] is True
+    assert "timed_pass" in record["error"]
+    assert "watchdog" in proc.stderr
+
+
+def test_phase_watchdog_quiet_when_phase_completes():
+    wd_body = (
+        "from memvul_tpu.bench import _PhaseWatchdog\n"
+        "wd = _PhaseWatchdog(30, 'm')\n"
+        "with wd.phase('fast'):\n"
+        "    pass\n"
+        "with wd.phase('disabled'):\n"  # timeout 0 disables entirely
+        "    pass\n"
+        f"print('{RESULT}')\n"
+    )
+    proc = subprocess.run(
+        _script_cmd(wd_body), capture_output=True, text=True, timeout=60
+    )
+    assert proc.returncode == 0
+    assert _extract_result_line(proc.stdout) == RESULT
+
+
+def test_supervise_retries_watchdog_timeout():
+    """A watchdog-killed attempt is the wedged-backend signature — the
+    supervisor must treat it as transient and burn a retry on it, then
+    surface the watchdog error once the budget is exhausted."""
+    body = (
+        "import sys\n"
+        'print(\'{"metric": "siamese_scoring_throughput", "value": 0.0, '
+        '"error": "watchdog: phase \\\'timed_pass\\\' exceeded 1s", '
+        '"watchdog_timeout": true}\')\n'
+        "sys.exit(124)\n"
+    )
+    line, err = _supervise(
+        _script_cmd(body), attempts=2, attempt_timeout=30, backoff=0
+    )
+    assert line is None
+    assert "watchdog" in err
 
 
 def test_supervise_success_first_try():

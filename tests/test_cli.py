@@ -220,6 +220,25 @@ def test_cli_train_then_evaluate_memory(ws, tmp_path):
         assert key in shipped_metrics
 
 
+def test_parse_mesh_flag():
+    """--mesh parsing: axis specs build the right mesh, malformed specs
+    fail with the usage hint BEFORE any training starts (the fast-tier
+    stand-in for the end-to-end mesh run below)."""
+    from memvul_tpu.__main__ import _parse_mesh
+
+    assert _parse_mesh(None) is None
+    mesh = _parse_mesh("data=8")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"data": 8}
+    mesh = _parse_mesh("data=4,model=2")
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 4, "model": 2,
+    }
+    for bad in ("data=7", "bogus=8", "data", "data=x"):
+        with pytest.raises(SystemExit):
+            _parse_mesh(bad)
+
+
+@pytest.mark.slow  # two full train+evaluate CLI runs over the 8-device mesh
 def test_cli_mesh_flag_end_to_end(ws, tmp_path):
     """--mesh through the CLI: dp training over all 8 virtual devices,
     then evaluation on a dp×tp mesh (model axis → TP param split + the
@@ -381,6 +400,8 @@ def test_cli_evaluate_golden_file_swaps_anchor_bank(ws, tmp_path):
     assert len(record["predict"]) == len(anchors)
 
 
+@pytest.mark.slow  # two full CLI runs just to watch trace dirs appear;
+# trace_context itself is covered fast in tests/test_profiling.py
 def test_cli_profile_flags_write_traces(ws, tmp_path):
     """--profile on train AND pretrain wraps the run in a jax.profiler
     trace scope; each trace dir must materialize (evaluate shares the

@@ -1,5 +1,7 @@
 import json
 
+import pytest
+
 from memvul_tpu.config import load_config, loads_config, merge_overrides
 
 
@@ -217,6 +219,7 @@ def test_merge_overrides_laws_property():
     (reference: predict_memory.py:60-67)."""
     import copy as _copy
 
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     from memvul_tpu.config import merge_overrides
@@ -295,6 +298,7 @@ def test_jsonnet_parser_roundtrips_fuzzed_comments_and_trailing_commas():
     the hand-written cases."""
     import re
 
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     json_values = st.recursive(
@@ -368,6 +372,7 @@ def test_jsonnet_parser_is_identity_on_valid_json():
     (the Jsonnet tolerance must never change the meaning of plain JSON —
     strings containing '//', 'local', semicolons, bound-looking
     identifiers, commas before brackets, etc.)."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     json_values = st.recursive(
@@ -388,3 +393,31 @@ def test_jsonnet_parser_is_identity_on_valid_json():
         assert loads_config(text) == json.loads(text)
 
     check()
+
+
+def test_evaluation_config_defaults_and_null_tolerance(caplog):
+    """The merged evaluation view: missing section → pure defaults,
+    explicit null falls back to the default (the long-standing
+    tokens_per_batch/inflight contract, now centralized), 0 survives as
+    a real value, and unknown keys are kept but logged (typo guard)."""
+    import logging
+
+    from memvul_tpu.config import EVALUATION_DEFAULTS, evaluation_config
+
+    assert evaluation_config(None) == EVALUATION_DEFAULTS
+    assert evaluation_config({}) == EVALUATION_DEFAULTS
+
+    merged = evaluation_config(
+        {"evaluation": {"inflight": 0, "tokens_per_batch": None,
+                        "anchor_match_impl": "fused", "aot_warmup": False}}
+    )
+    assert merged["inflight"] == 0  # 0 is a real value (sync dispatch)
+    assert merged["tokens_per_batch"] is None  # null → default
+    assert merged["anchor_match_impl"] == "fused"
+    assert merged["aot_warmup"] is False
+    assert merged["batch_size"] == EVALUATION_DEFAULTS["batch_size"]
+
+    with caplog.at_level(logging.WARNING, logger="memvul_tpu.config"):
+        merged = evaluation_config({"evaluation": {"ancor_match_impl": "xla"}})
+    assert merged["ancor_match_impl"] == "xla"  # kept for newer readers
+    assert any("ancor_match_impl" in r.message for r in caplog.records)

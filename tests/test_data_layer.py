@@ -547,6 +547,7 @@ def test_split_by_project_partition_property():
     the project-level split is a PARTITION of the reports, no project
     ever straddles the boundary (the leak-guard invariant, reference:
     utils.py:115-152), and a fixed seed is reproducible."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     @settings(max_examples=60, deadline=None)
@@ -587,6 +588,7 @@ def test_auto_buckets_is_exactly_optimal_vs_brute_force():
     mean minimize, not approximately."""
     from itertools import combinations
 
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     from memvul_tpu.data.batching import auto_buckets
@@ -639,6 +641,7 @@ def test_bucketed_batches_partition_property():
     batching is a PARTITION — every instance appears in exactly one batch
     row, each row sits in the smallest covering bucket, and every batch
     has its bucket's fixed shape (the static-shape contract XLA needs)."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     from memvul_tpu.data.batching import bucketed_batches_from_instances

@@ -275,6 +275,61 @@ def test_buckets_must_cover_max_length(ws, memory_setup):
         )
 
 
+def test_aot_warmup_precompiles_every_bucket_shape(ws, memory_setup):
+    """encode_anchors ends with the AOT shape warmup: one score-program
+    compile per (bucket, batch-rows) shape, and STREAMING MUST NOT
+    compile anything further — the probe counts jit cache misses, so a
+    mid-stream compile would show as a count bump."""
+    model, params, reader = memory_setup
+    pred = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=8, max_length=64,
+        buckets=(16, 32, 64), tokens_per_batch=256,
+    )
+    assert pred.score_trace_count == 0
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    shapes = pred.stream_shapes()
+    assert len(shapes) == 3  # one per bucket, rows from the token budget
+    assert pred.score_trace_count == len(shapes)
+    n = 0
+    for probs, metas in pred.score_instances(
+        reader.read(ws["paths"]["test"], split="test")
+    ):
+        n += len(metas)
+    assert n > 0
+    assert pred.score_trace_count == len(shapes), (
+        "streaming hit a shape outside the precompiled set"
+    )
+
+
+def test_aot_warmup_no_buckets_single_shape(ws, memory_setup):
+    """Pad-to-max mode has exactly one stream shape to precompile."""
+    model, params, reader = memory_setup
+    pred = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=8, max_length=64
+    )
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    assert pred.stream_shapes() == [(8, 64)]
+    assert pred.score_trace_count == 1
+    for _ in pred.score_instances(reader.read(ws["paths"]["test"], split="test")):
+        pass
+    assert pred.score_trace_count == 1
+
+
+def test_aot_warmup_opt_out(ws, memory_setup):
+    """aot_warmup=False restores compile-on-first-occurrence (the lazy
+    behavior tiny interactive runs may prefer)."""
+    model, params, reader = memory_setup
+    pred = SiamesePredictor(
+        model, params, ws["tokenizer"], batch_size=8, max_length=64,
+        aot_warmup=False,
+    )
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    assert pred.score_trace_count == 0
+    for _ in pred.score_instances(reader.read(ws["paths"]["test"], split="test")):
+        pass
+    assert pred.score_trace_count == 1  # compiled lazily, mid-stream
+
+
 def test_single_predictor_bucket_token_budget(ws):
     """tokens_per_batch drives per-bucket batch sizes on the single path
     too (the config field is honored end-to-end)."""

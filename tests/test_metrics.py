@@ -50,6 +50,7 @@ def test_find_best_threshold_matches_brute_force_property():
     ``>=``-update arithmetic, custom_metric.py:35-52).  This metric
     gates model selection (+s_f1-score), so 'best' must be provable, not
     approximate."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     def prf(tp, fn, fp):

@@ -117,6 +117,9 @@ def test_oversized_doc_falls_back():
     assert out[2] == normalize_text("small CVE-2021-2 doc")
 
 
+@pytest.mark.slow  # ~20 s: the Python-spec normalize of 16KB docs is the
+# cost; the over/under fallback behavior stays covered fast by
+# test_oversized_doc_falls_back
 def test_caller_stack_cap_boundary():
     """Documents at the 16KB single-doc boundary: just-below normalizes
     natively, just-above returns NULL (Python fallback)."""

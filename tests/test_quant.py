@@ -185,6 +185,7 @@ def test_int8_matmul_error_bound_property():
     """Property (hypothesis): the dynamic-int8 matmul error stays within
     the analytic bound K * s_x * s_w (one half-step of each scale per
     contraction term, doubled for slack) for arbitrary shapes/values."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     @settings(max_examples=40, deadline=None)

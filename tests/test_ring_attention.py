@@ -77,6 +77,7 @@ def test_ring_matches_xla_for_arbitrary_length_mixes(seq_mesh):
     all-masked-zeros contract) in one batch.  Generalizes the
     hand-picked ragged cases; the travelling-key-mask arithmetic must
     hold for every boundary alignment."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
     from hypothesis import given, settings, strategies as st
 
     T = 64

@@ -15,6 +15,28 @@ sys.path.insert(0, "tools")
 import tpu_proofs  # noqa: E402
 
 
+def _check_train_case(**kw):
+    case = tpu_proofs._train_case(
+        K=kw.get("K", 2), B=kw.get("B", 2), L=32, n_steps=2,
+        preset="tiny",
+        remat=kw.get("remat", True),
+        attention_impl=kw.get("attention_impl", "xla"),
+    )
+    assert case["steady_step_mean_s"] > 0
+    assert case["pairs_per_s"] > 0
+    g = case["geometry"]
+    assert g["model"] == "bert-tiny"
+    assert g["attention_impl"] == kw.get("attention_impl", "xla")
+
+
+def test_train_case_tiny_default_variant():
+    """The default A/B case builds and steps — the fast-tier harness
+    check (each extra variant is a fresh ~15 s trainer compile; the full
+    sweep runs in the slow tier below)."""
+    _check_train_case()
+
+
+@pytest.mark.slow  # 4 trainer compiles ≈ 1 min on the tier-1 host
 def test_train_case_tiny_runs_all_ab_variants():
     """Every A/B lever (remat, microbatch, flash attention) builds and
     steps at tiny geometry — the exact code run_trainab uses on chip."""
@@ -24,17 +46,7 @@ def test_train_case_tiny_runs_all_ab_variants():
         dict(K=1, B=4),
         dict(attention_impl="flash"),
     ):
-        case = tpu_proofs._train_case(
-            K=kw.get("K", 2), B=kw.get("B", 2), L=32, n_steps=2,
-            preset="tiny",
-            remat=kw.get("remat", True),
-            attention_impl=kw.get("attention_impl", "xla"),
-        )
-        assert case["steady_step_mean_s"] > 0
-        assert case["pairs_per_s"] > 0
-        g = case["geometry"]
-        assert g["model"] == "bert-tiny"
-        assert g["attention_impl"] == kw.get("attention_impl", "xla")
+        _check_train_case(**kw)
 
 
 def test_bf16drift_tiny_cpu(tmp_path, monkeypatch):

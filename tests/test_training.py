@@ -236,6 +236,9 @@ def test_trainer_sharded_step(ws, tmp_path):
     assert np.isfinite(result["history"][0]["training_loss"])
 
 
+@pytest.mark.slow  # checkify-instrumented compile dominates (~1 min on the
+# tier-1 host); the nan-localization test below compiles the same
+# instrumented step, keeping debug_checks covered in the fast tier
 def test_trainer_debug_checks_clean_run(ws, tmp_path):
     """debug_checks mode trains normally on healthy data."""
     trainer = make_trainer(
