@@ -72,21 +72,16 @@ import tempfile
 import threading
 import time
 
+from memvul_tpu.resilience.retry import RETRYABLE_MARKERS, RetryPolicy
+
 BASELINE_RPS_512 = 190.0  # estimated GTX-3090 throughput at seq_len 512 (above)
 
-# Substrings marking a transient backend failure worth retrying (the
-# round-2 capture died with the first one).  A watchdog phase-timeout is
-# retryable too: a phase that stops making progress mid-run is the
-# silently-wedged-backend signature, same as a hung first device op.
-_RETRYABLE_MARKERS = (
-    "UNAVAILABLE",
-    "Unable to initialize backend",
-    "DEADLINE_EXCEEDED",
-    "ABORTED",
-    "Socket closed",
-    "failed to connect",
-    "watchdog: phase",
-)
+# The transient-failure classification now lives in resilience/retry.py
+# (shared with the corpus-scoring path — the backend that answers
+# UNAVAILABLE to the bench is the one that throws it at batch 900k of a
+# scoring run).  The old private name stays as an alias for external
+# importers.
+_RETRYABLE_MARKERS = RETRYABLE_MARKERS
 
 _CHILD_ENV_FLAG = "MEMVUL_BENCH_CHILD"
 
@@ -572,9 +567,11 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
     """Run ``cmd`` until it emits a bench-result JSON line.
 
     Returns (result_line, None) on success or (None, short_error) after the
-    retry budget is exhausted.  Only transient backend failures (markers
-    above) and deadline kills are retried; a genuine bug fails fast.
+    retry budget is exhausted.  Only transient backend failures (the shared
+    classification in resilience/retry.py) and deadline kills are retried;
+    a genuine bug fails fast.
     """
+    policy = RetryPolicy(attempts=attempts, backoff=backoff)
     last_error = "no attempts were made"
     for attempt in range(1, attempts + 1):
         proc = subprocess.Popen(
@@ -622,15 +619,15 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
             exc = [l for l in tail if re.match(r"^[\w.]+(Error|Exception)\b", l)]
             pick = exc[-1] if exc else (tail[-1] if tail else None)
             last_error = pick[:300] if pick else f"rc={proc.returncode}"
-            if not any(m in (err + out) for m in _RETRYABLE_MARKERS):
+            if not policy.is_transient(err + out):
                 return None, last_error  # not transient: don't burn retries
 
         if attempt < attempts:
             sys.stderr.write(
                 f"bench attempt {attempt}/{attempts} failed ({last_error}); "
-                f"retrying in {backoff * attempt:.0f}s\n"
+                f"retrying in {policy.delay(attempt):.0f}s\n"
             )
-            time.sleep(backoff * attempt)
+            time.sleep(policy.delay(attempt))
     return None, last_error
 
 

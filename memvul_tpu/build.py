@@ -393,6 +393,10 @@ def evaluate_from_archive(
             inflight=inflight,
             anchor_match_impl=eval_cfg["anchor_match_impl"],
             aot_warmup=bool(eval_cfg["aot_warmup"]),
+            resume=bool(eval_cfg["resume"]),
+            quarantine=eval_cfg["quarantine"],
+            heartbeat_batches=int(eval_cfg["heartbeat_batches"]),
+            score_retries=int(eval_cfg["score_retries"]),
         )
     from .evaluate.predict_single import test_single
 

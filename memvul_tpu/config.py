@@ -232,6 +232,13 @@ EVALUATION_DEFAULTS: Dict[str, Any] = {
     "inflight": 2,           # async device dispatch depth (0 = sync)
     "anchor_match_impl": None,  # None → model config ("auto"|"fused"|"xla")
     "aot_warmup": True,      # precompile every stream shape at startup
+    # fault tolerance (docs/fault_tolerance.md) — all off by default so
+    # short interactive evals keep their exact historical behavior;
+    # docs/full_corpus.md turns the whole block on for the 1.2M job
+    "resume": False,         # journal + skip-completed restartable scoring
+    "quarantine": False,     # dead-letter malformed/over-long records
+    "heartbeat_batches": 0,  # progress log every N batches (0 = off)
+    "score_retries": 0,      # transient-failure retries per batch (0 = off)
 }
 
 

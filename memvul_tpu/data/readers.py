@@ -33,6 +33,7 @@ from pathlib import Path
 from typing import Dict, Iterator, List, Optional
 
 from ..registry import Registrable
+from ..resilience import faults
 from .normalize import normalize_text
 
 logger = logging.getLogger(__name__)
@@ -53,20 +54,50 @@ def detect_split(file_path: str) -> str:
     return TRAIN
 
 
-def _iter_corpus(file_path: str) -> Iterator[Dict]:
+def _iter_corpus(file_path: str, quarantine=None) -> Iterator[Dict]:
     """Stream raw sample dicts from a corpus file.
 
     ``.jsonl`` files (one record per line) stream without ever holding
     the corpus in memory — the format for the full 1.2M-report scoring
     job; plain ``.json`` arrays (the reference's artifact format,
-    utils.py:353-381) load at once."""
+    utils.py:353-381) load at once.
+
+    With a ``quarantine`` (:class:`..resilience.journal.DeadLetter`),
+    a record that fails to parse is dead-lettered with its reason and
+    the stream continues — one corrupt line at report 900k must not
+    kill an hours-long scoring pass.  Without one, the error propagates
+    (training keeps its fail-fast contract).  The ``data.read`` fault
+    point fires per record, inside the quarantined window."""
     if str(file_path).endswith(".jsonl"):
         with open(file_path, encoding="utf-8") as f:
-            for line in f:
-                if line.strip():
-                    yield json.loads(line)
+            for lineno, line in enumerate(f):
+                if not line.strip():
+                    continue
+                try:
+                    faults.fault_point("data.read")
+                    record = json.loads(line)
+                except Exception as e:
+                    if quarantine is None:
+                        raise
+                    quarantine.record(
+                        f"line {lineno}: {type(e).__name__}: {e}", raw=line
+                    )
+                    continue
+                yield record
     else:
-        yield from json.loads(Path(file_path).read_text())
+        for i, record in enumerate(json.loads(Path(file_path).read_text())):
+            try:
+                faults.fault_point("data.read")
+            except Exception as e:
+                if quarantine is None:
+                    raise
+                quarantine.record(
+                    f"record {i}: {type(e).__name__}: {e}",
+                    meta={"Issue_Url": record.get("Issue_Url")}
+                    if isinstance(record, dict) else None,
+                )
+                continue
+            yield record
 
 
 class DatasetReader(Registrable):
@@ -98,6 +129,14 @@ class MemoryReader(DatasetReader):
         if anchor_path:
             self._anchors = json.loads(Path(anchor_path).read_text())
         self._grouped_cache: Dict[str, Dict[str, List[Dict]]] = {}
+
+    def reseed(self, seed: int) -> None:
+        """Re-seed the pair-sampling RNG.  The trainer calls this at
+        every epoch start so each epoch's pair stream is a pure function
+        of (trainer seed, epoch index) — the property that lets a
+        preempted run replay the interrupted epoch's stream exactly
+        (training/trainer.py:_epoch_seed)."""
+        self._rng.seed(seed)
 
     # -- corpus handling -----------------------------------------------------
 
@@ -145,7 +184,12 @@ class MemoryReader(DatasetReader):
 
     # -- instance generation -------------------------------------------------
 
-    def read(self, file_path: str, split: Optional[str] = None) -> Iterator[Dict]:
+    def read(
+        self,
+        file_path: str,
+        split: Optional[str] = None,
+        quarantine=None,
+    ) -> Iterator[Dict]:
         split = split or detect_split(file_path)
         if split == GOLDEN:
             yield from self.read_anchors(file_path)
@@ -157,6 +201,8 @@ class MemoryReader(DatasetReader):
             # one-pass, so the corpus streams sample-by-sample — a .jsonl
             # file never materializes in host RAM (the 1.2M-report job);
             # a cached grouped corpus is reused when one exists.
+            # ``quarantine`` (a resilience.DeadLetter) makes the stream
+            # survive malformed/over-long records by dead-lettering them.
             mode = "test" if split == VALIDATION else UNLABEL
             count = 0
             if file_path in self._grouped_cache:
@@ -166,18 +212,41 @@ class MemoryReader(DatasetReader):
                     for s in bucket
                 )
             else:
-                samples = (
-                    prepared
-                    for s in _iter_corpus(file_path)
-                    if (prepared := self._prepare_sample(s)) is not None
-                )
+                samples = self._prepared_stream(file_path, quarantine)
             for s in samples:
+                if (
+                    quarantine is not None
+                    and len(s.get("text") or "") > quarantine.max_text_chars
+                ):
+                    quarantine.record(
+                        f"over-long text ({len(s['text'])} chars > "
+                        f"{quarantine.max_text_chars} cap)",
+                        meta={"Issue_Url": s.get("Issue_Url")},
+                    )
+                    continue
                 count += 1
                 yield self._eval_instance(s, mode)
             logger.info("%s: %d evaluation instances", file_path, count)
         else:
             # pair generation needs same-CWE partner lookup: grouped corpus
+            # (training keeps its fail-fast contract: no quarantine here)
             yield from self._train_pairs(self.group_by_cwe(file_path))
+
+    def _prepared_stream(self, file_path: str, quarantine) -> Iterator[Dict]:
+        for s in _iter_corpus(file_path, quarantine=quarantine):
+            try:
+                prepared = self._prepare_sample(s)
+            except Exception as e:
+                if quarantine is None:
+                    raise
+                quarantine.record(
+                    f"prepare failed: {type(e).__name__}: {e}",
+                    meta={"Issue_Url": s.get("Issue_Url")}
+                    if isinstance(s, dict) else None,
+                )
+                continue
+            if prepared is not None:
+                yield prepared
 
     def read_anchors(self, anchor_path: Optional[str] = None) -> Iterator[Dict]:
         anchors = (

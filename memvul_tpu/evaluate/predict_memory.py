@@ -35,6 +35,9 @@ from ..data.batching import (
 from ..data.readers import MemoryReader
 from ..models.memory import MemoryModel, anchor_probs
 from ..parallel.mesh import MODEL_AXIS, create_mesh, replicate, shard_batch
+from ..resilience import faults
+from ..resilience.journal import DeadLetter, ScoreJournal
+from ..resilience.retry import RetryPolicy, exception_text
 from ..training.metrics import SiameseMeasure
 from .measure import cal_metrics
 
@@ -93,6 +96,13 @@ class SiamesePredictor:
         self._encode_fn = jax.jit(
             lambda p, b: self.model.apply(p, b, deterministic=True)
         )
+        self._build_score_fn()
+
+    def _build_score_fn(self) -> None:
+        """(Re)build the jitted score program.  Reads
+        ``self.anchor_match_impl`` at trace time, so a degradation to
+        "xla" only needs a fresh jit wrapper (old fused executables die
+        with the old wrapper's cache)."""
 
         def _score(p, b, bank):
             self.score_trace_count += 1  # host-side, runs at trace only
@@ -104,6 +114,27 @@ class SiamesePredictor:
             )
 
         self._score_fn = jax.jit(_score)
+
+    def _maybe_degrade_to_xla(self, error: BaseException) -> bool:
+        """Mosaic/Pallas failures that escaped the trace-time fallback in
+        ``ops.pallas.anchor_match`` (they surface at the enclosing jit's
+        *compile*): rebuild the score program on the jnp decomposition —
+        parity-pinned ≤1e-5 vs fused — instead of aborting the run.
+        Returns True when the caller should retry the failed operation."""
+        if self.anchor_match_impl == "xla":
+            return False
+        text = f"{type(error).__name__}: {error}".lower()
+        if not any(m in text for m in ("mosaic", "pallas", "lowering")):
+            return False
+        logger.warning(
+            "score program failed to build on the fused anchor-match "
+            "kernel (%s) — degrading to anchor_match_impl='xla' "
+            "(identical scores; see docs/anchor_match_kernel.md)",
+            f"{type(error).__name__}: {error}",
+        )
+        self.anchor_match_impl = "xla"
+        self._build_score_fn()
+        return True
 
     # -- phase 1: anchor bank ------------------------------------------------
 
@@ -197,7 +228,15 @@ class SiamesePredictor:
             }
             if self.mesh is not None:
                 sample = shard_batch(sample, self.mesh)
-            self._score_fn.lower(self.params, sample, self.anchor_bank).compile()
+            try:
+                self._score_fn.lower(self.params, sample, self.anchor_bank).compile()
+            except Exception as e:
+                if not self._maybe_degrade_to_xla(e):
+                    raise
+                # the rebuilt program invalidates any shapes already
+                # compiled on the fused one — restart the warmup so the
+                # zero-mid-stream-compile contract still holds
+                return self.warmup_compile()
         logger.info(
             "AOT warmup: %d score program(s) %s compiled in %.1fs",
             len(shapes), shapes, time.perf_counter() - start,
@@ -211,6 +250,7 @@ class SiamesePredictor:
         instances: Iterable[Dict],
         prefetch_depth: int = 4,
         inflight: int = 2,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> Iterator[Tuple[np.ndarray, List[Dict]]]:
         """Yields (per-report best anchor probabilities [b, A], metas) per
         batch, padding rows removed.
@@ -221,6 +261,13 @@ class SiamesePredictor:
         idle between steps (the per-batch host sync was the round-1
         throughput leak).  With buckets set, batches arrive length-binned
         via :func:`bucketed_batches_from_instances`.
+
+        ``retry_policy`` makes a *transient* backend failure on a batch
+        (the shared UNAVAILABLE/DEADLINE_EXCEEDED classification,
+        resilience/retry.py) cost one re-dispatch instead of the stream:
+        failures are caught both at dispatch and at the host-side sync
+        where asynchronously-dispatched errors surface.  Non-transient
+        errors propagate immediately either way.
         """
         if self.anchor_bank is None:
             raise RuntimeError("call encode_anchors() first")
@@ -241,17 +288,43 @@ class SiamesePredictor:
                 pad_to_max=True,
             )
         def dispatch(batch):
-            sample = batch["sample1"]
-            if self.mesh is not None:
-                sample = shard_batch(sample, self.mesh)
-            return self._score_fn(self.params, sample, self.anchor_bank)
+            def once():
+                # chaos hook: fires per batch, inside the retried window
+                faults.fault_point("score.batch")
+                sample = batch["sample1"]
+                if self.mesh is not None:
+                    sample = shard_batch(sample, self.mesh)
+                return self._score_fn(self.params, sample, self.anchor_bank)
+
+            try:
+                if retry_policy is None:
+                    return once()
+                return retry_policy.call(once, description="score batch")
+            except Exception as e:
+                if self._maybe_degrade_to_xla(e):
+                    return once()  # re-dispatch through the rebuilt program
+                raise
 
         for dev, batch in inflight_pipeline(
             prefetch(batches, depth=prefetch_depth), dispatch, inflight=inflight
         ):
             metas = batch["meta"]
+            try:
+                arr = np.asarray(dev)
+            except Exception as e:
+                # an asynchronously-dispatched batch failed on device;
+                # the error only surfaces here, at the blocking sync
+                if retry_policy is None or not retry_policy.is_transient(
+                    exception_text(e)
+                ):
+                    raise
+                logger.warning(
+                    "batch failed at host sync (%s) — re-dispatching",
+                    exception_text(e)[:200],
+                )
+                arr = np.asarray(dispatch(batch))
             # drop dead rows and any zero-padded anchor columns
-            yield np.asarray(dev)[: len(metas), : self.n_anchors], metas
+            yield arr[: len(metas), : self.n_anchors], metas
 
     def predict_file(
         self,
@@ -260,6 +333,10 @@ class SiamesePredictor:
         out_path: Union[str, Path],
         split: Optional[str] = None,
         inflight: int = 2,
+        resume: bool = False,
+        quarantine: Union[bool, str, Path, None] = None,
+        heartbeat_batches: int = 0,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> Dict[str, float]:
         """Stream a corpus file, write the reference-format result lines,
         return the threshold-swept siamese metrics.
@@ -268,12 +345,65 @@ class SiamesePredictor:
         dedicated writer thread: at corpus-scale throughput that is
         hundreds of thousands of float-to-text conversions per second,
         which would otherwise sit on the same thread that syncs device
-        results and starve the dispatch pipeline."""
+        results and starve the dispatch pipeline.
+
+        Fault tolerance (docs/fault_tolerance.md):
+
+        * ``resume=True`` keeps an append-only progress journal
+          (``<out>.journal``) of committed output lines; a restarted run
+          verifies the journal against the output file, skips every
+          report the verified prefix covers, and finishes with metrics
+          identical to an uninterrupted run.
+        * ``quarantine`` (True for ``<out>.deadletter``, or a path)
+          dead-letters malformed/over-long records with reasons instead
+          of killing the stream.
+        * ``heartbeat_batches=N`` logs progress every N batches —
+          reports/s, batches this run vs journal total, quarantine count
+          — so a stalled corpus run is distinguishable from a slow one.
+        * ``retry_policy`` retries transiently-failing batches
+          (see :meth:`score_instances`).
+        """
         import queue
         import threading
 
+        out_path = Path(out_path)
         measure = SiameseMeasure()
         n = 0
+        n_resumed = 0
+        journal: Optional[ScoreJournal] = None
+        completed: set = set()
+        dead: Optional[DeadLetter] = None
+        if quarantine:
+            dead_path = (
+                Path(quarantine)
+                if not isinstance(quarantine, bool)
+                else Path(str(out_path) + ".deadletter")
+            )
+            dead = DeadLetter(dead_path)
+        journal_path = Path(str(out_path) + ".journal")
+        if resume:
+            journal = ScoreJournal(journal_path)
+            kept_n, completed, kept_lines = journal.verified_prefix(out_path)
+            # drop the unverified tail (torn final line / journal entries
+            # whose output never landed) so this run redoes those rows
+            journal.truncate_to(kept_n, out_path)
+            for line in kept_lines:
+                for rec in json.loads(line):
+                    preds = rec.get("predict") or {}
+                    score = max(preds.values()) if preds else 0.0
+                    measure.update([score], [{"label": rec.get("label")}])
+                    n += 1
+            n_resumed = n
+            if kept_n:
+                logger.info(
+                    "resume: %d journaled output lines verified (%d "
+                    "reports) — skipping their spans", kept_n, n_resumed,
+                )
+        elif journal_path.exists():
+            # a fresh (non-resume) run overwrites the output; a stale
+            # journal beside it would poison a LATER resume
+            journal_path.unlink()
+
         start = time.perf_counter()
         q: "queue.Queue" = queue.Queue(maxsize=16)
         writer_error: List[BaseException] = []
@@ -281,7 +411,7 @@ class SiamesePredictor:
 
         def _writer() -> None:
             try:
-                with open(out_path, "w") as f:
+                with open(out_path, "a" if resume else "w") as f:
                     while True:
                         item = q.get()
                         if item is None:
@@ -298,16 +428,32 @@ class SiamesePredictor:
                             }
                             for row, meta in zip(probs, metas)
                         ]
-                        f.write(json.dumps(records) + "\n")
+                        text = json.dumps(records)
+                        f.write(text + "\n")
+                        if journal is not None:
+                            # the journal entry is the durable claim that
+                            # the line landed — flush the line first
+                            f.flush()
+                            journal.append(
+                                journal.entries_written,
+                                [meta["_row"] for meta in metas],
+                                text,
+                            )
             except BaseException as e:  # propagated to the caller below
                 writer_error.append(e)
                 failed.set()
 
+        instances = reader.read(str(test_path), split=split, quarantine=dead) \
+            if dead is not None else reader.read(str(test_path), split=split)
+        if journal is not None:
+            instances = _indexed_stream(instances, completed)
+
         writer = threading.Thread(target=_writer, daemon=True)
         writer.start()
+        batches_done = 0
         try:
             for probs, metas in self.score_instances(
-                reader.read(str(test_path), split=split), inflight=inflight
+                instances, inflight=inflight, retry_policy=retry_policy
             ):
                 while not failed.is_set():
                     try:
@@ -319,6 +465,19 @@ class SiamesePredictor:
                     break
                 measure.update(probs.max(axis=-1), metas)
                 n += len(metas)
+                batches_done += 1
+                if heartbeat_batches and batches_done % heartbeat_batches == 0:
+                    elapsed = time.perf_counter() - start
+                    logger.info(
+                        "scoring heartbeat: %d batches this run (journal "
+                        "total %s), %d/%d reports, %.0f reports/s, %d "
+                        "quarantined",
+                        batches_done,
+                        journal.entries_written if journal is not None else "-",
+                        n - n_resumed, n,
+                        (n - n_resumed) / max(elapsed, 1e-9),
+                        dead.count if dead is not None else 0,
+                    )
         finally:
             # signal end-of-stream with the same failure-aware loop as the
             # data puts: the writer may die (and stop consuming) at any
@@ -336,16 +495,41 @@ class SiamesePredictor:
                 except queue.Full:
                     continue
             writer.join()
+            if journal is not None:
+                journal.close()
+            if dead is not None:
+                dead.close()
         if writer_error:
             raise writer_error[0]
         elapsed = time.perf_counter() - start
         logger.info(
-            "scored %d reports in %.1fs (%.0f reports/s)", n, elapsed, n / max(elapsed, 1e-9)
+            "scored %d reports in %.1fs (%.0f reports/s)%s%s",
+            n - n_resumed, elapsed, (n - n_resumed) / max(elapsed, 1e-9),
+            f", {n_resumed} resumed from journal" if n_resumed else "",
+            f", {dead.count} quarantined" if dead is not None and dead.count else "",
         )
         metrics = measure.compute(reset=True)
         metrics["num_samples"] = n
         metrics["elapsed_s"] = elapsed
+        if dead is not None:
+            metrics["num_quarantined"] = dead.count
         return metrics
+
+
+def _indexed_stream(instances: Iterable[Dict], completed: set) -> Iterator[Dict]:
+    """Stamp each instance's meta with its input-stream index (``_row``,
+    what the journal records) and drop the rows a verified resume prefix
+    already covers.  Indices number the post-quarantine stream; the
+    quarantine's drop decisions are deterministic for a given corpus
+    file, so the numbering is stable across a kill/resume boundary."""
+    for i, inst in enumerate(instances):
+        if i in completed:
+            continue
+        inst = dict(inst)
+        meta = dict(inst.get("meta") or {})
+        meta["_row"] = i
+        inst["meta"] = meta
+        yield inst
 
 
 def test_siamese(
@@ -367,9 +551,18 @@ def test_siamese(
     inflight: int = 2,
     anchor_match_impl: Optional[str] = None,
     aot_warmup: bool = True,
+    resume: bool = False,
+    quarantine: Union[bool, str, Path, None] = None,
+    heartbeat_batches: int = 0,
+    score_retries: int = 0,
 ) -> Dict[str, float]:
     """End-to-end evaluation mirroring the reference's ``test_siamese``
-    (predict_memory.py:49-114) + ``cal_metrics`` (:159-197)."""
+    (predict_memory.py:49-114) + ``cal_metrics`` (:159-197).
+
+    ``resume``/``quarantine``/``heartbeat_batches`` are forwarded to
+    :meth:`SiamesePredictor.predict_file`; ``score_retries`` > 0 builds
+    the shared transient-failure :class:`RetryPolicy` with that attempt
+    budget (docs/fault_tolerance.md)."""
     reader = reader or MemoryReader()
     if mesh is None and use_mesh and len(jax.devices()) > 1:
         mesh = create_mesh()
@@ -387,7 +580,12 @@ def test_siamese(
     )
     predictor.encode_anchors(reader.read_anchors(str(golden_file)))
     eval_metrics = predictor.predict_file(
-        reader, test_file, out_results, inflight=inflight
+        reader, test_file, out_results, inflight=inflight,
+        resume=resume,
+        quarantine=quarantine,
+        heartbeat_batches=heartbeat_batches,
+        retry_policy=RetryPolicy(attempts=score_retries)
+        if score_retries > 0 else None,
     )
     final = cal_metrics(out_results, thres=thres, out_file=out_metrics)
     final.update({f"s_{k}": v for k, v in eval_metrics.items()})

@@ -99,6 +99,13 @@ class MemoryModel(nn.Module):
         through VMEM so it never touches HBM; elsewhere (and for a
         model-sharded bank) the jnp decomposition runs
         (ops/pallas/anchor_match.py).
+
+        Degradation: a Pallas/Mosaic build failure in the fused path
+        falls back to the jnp decomposition with one warning instead of
+        aborting (the two are parity-pinned ≤1e-5) — the dispatch in
+        ``ops.pallas.anchor_match`` handles trace-time failures, and
+        ``SiamesePredictor`` rebuilds its score program on "xla" for
+        failures that only surface at jit-compile time.
         """
         from ..ops.pallas.anchor_match import anchor_match
 

@@ -225,6 +225,26 @@ def fused_anchor_match(
     return out
 
 
+_fallback_warned = False
+
+
+def _warn_fused_fallback(error: BaseException) -> None:
+    """One warning per process — a million-batch scoring run must not
+    log the same degradation a million times."""
+    global _fallback_warned
+    if _fallback_warned:
+        return
+    _fallback_warned = True
+    import logging
+
+    logging.getLogger(__name__).warning(
+        "fused anchor-match kernel failed to build (%s: %s) — degrading "
+        "to anchor_match_impl='xla' (identical scores, loses the VMEM-"
+        "streaming HBM win; see docs/anchor_match_kernel.md)",
+        type(error).__name__, error,
+    )
+
+
 def anchor_match(
     u: jax.Array,
     anchors: jax.Array,
@@ -241,6 +261,16 @@ def anchor_match(
     * ``"xla"``: always the jnp decomposition (also the forced choice
       for a model-sharded anchor bank, where the SPMD partitioner must
       split the contraction — see SiamesePredictor).
+
+    When the kernel path fails to *build* (a Pallas/Mosaic trace-time
+    failure — e.g. an unsupported shape on a new TPU generation, or the
+    injected ``kernel.lower`` fault), the dispatch degrades to the jnp
+    decomposition with one warning instead of aborting the run: the two
+    formulations are parity-pinned ≤1e-5 (tests/test_anchor_match_kernel
+    .py), so the degradation costs HBM bandwidth, never correctness.
+    Compile-time Mosaic failures surface later, at the enclosing jit's
+    compile — ``SiamesePredictor`` catches those and rebuilds its score
+    program on "xla" (evaluate/predict_memory.py).
     """
     if impl is None or impl == "auto":
         from ...utils.platform import is_tpu_backend
@@ -255,5 +285,11 @@ def anchor_match(
             f"unknown anchor_match impl {impl!r} (want auto | fused | xla)"
         )
     if use_fused:
-        return fused_anchor_match(u, anchors, kernel, interpret=interpret)
+        from ...resilience import faults
+
+        try:
+            faults.fault_point("kernel.lower")
+            return fused_anchor_match(u, anchors, kernel, interpret=interpret)
+        except Exception as e:
+            _warn_fused_fallback(e)
     return anchor_match_reference(u, anchors, kernel)

@@ -1,0 +1,25 @@
+"""Fault-tolerant execution layer.
+
+Long-running jobs here are measured in hours (a 1.2M-report scoring
+pass) or days (a training run on a preemptible pod): a SIGTERM, a bad
+corpus record, or a transiently wedged backend must cost seconds of
+rework, not the whole job.  This package holds the shared machinery the
+training and scoring paths build their recovery on:
+
+* :mod:`faults`  — deterministic, env-driven fault injection (named
+  points, chosen trigger counts) so chaos tests drive the REAL recovery
+  code paths instead of mocks;
+* :mod:`retry`   — the one transient-failure classification + backoff
+  policy (generalized from the bench supervisor's);
+* :mod:`journal` — append-only progress journal + dead-letter
+  quarantine for restartable corpus scoring;
+* :mod:`io`      — atomic (tmp + ``os.replace``) small-file writes for
+  markers, manifests and metadata sidecars.
+
+See docs/fault_tolerance.md for the operator-facing contract.
+"""
+
+from . import faults  # noqa: F401
+from .io import atomic_write_text  # noqa: F401
+from .journal import DeadLetter, ScoreJournal  # noqa: F401
+from .retry import RETRYABLE_MARKERS, RetryPolicy, exception_text  # noqa: F401

@@ -1,0 +1,199 @@
+"""Deterministic fault injection for chaos tests.
+
+Production code is salted with **named injection points** — a call to
+:func:`fault_point` at the spot where the real world can hurt it:
+
+=================  ==========================================================
+point              fires
+=================  ==========================================================
+``data.read``      once per raw corpus record, before it is parsed
+``ckpt.write``     inside the atomic-write helper, after the tmp file is
+                   written but before ``os.replace`` commits it (the torn-
+                   write window)
+``score.batch``    once per scoring batch, at dispatch
+``step.N``         at the start of optimizer step ``N`` (global step index)
+``kernel.lower``   when the fused Pallas anchor-match kernel is selected,
+                   before it is traced (simulates a Mosaic lowering failure)
+=================  ==========================================================
+
+With no configuration every point is a near-zero-cost no-op.  Arming is
+via the ``MEMVUL_FAULTS`` environment variable (read once, at the first
+``fault_point`` call) or programmatically via :func:`configure`:
+
+    MEMVUL_FAULTS="score.batch@3=raise:RuntimeError:UNAVAILABLE injected"
+    MEMVUL_FAULTS="step.4=sigterm;data.read@2=raise:ValueError:bad record"
+
+Grammar: ``;``-separated clauses, each ``point[@n]=action`` —
+
+* ``@n``: the 1-based hit count at which the fault fires (default 1);
+* ``raise[:ExcName[:message]]``: raise a builtin exception (default
+  ``RuntimeError("injected fault")``);
+* ``sigterm`` / ``sigint``: deliver that signal to the current process
+  (``os.kill`` — the delivery path is identical to an external kill, so
+  the handler under test is the production handler).
+
+Each clause fires exactly **once** and then disarms, so a retry loop
+that survives its injected failure proceeds normally — the property the
+transient-failure tests depend on.
+"""
+
+from __future__ import annotations
+
+import builtins
+import dataclasses
+import os
+import signal
+import threading
+from typing import Dict, List, Optional
+
+_ENV_VAR = "MEMVUL_FAULTS"
+
+_lock = threading.Lock()
+_faults: Dict[str, List["_Fault"]] = {}
+_armed = False  # fast-path gate: fault_point returns immediately when False
+_env_loaded = False
+
+
+@dataclasses.dataclass
+class _Fault:
+    point: str
+    trigger: int = 1  # fire at the trigger-th hit of the point
+    action: str = "raise"  # "raise" | "sigterm" | "sigint"
+    exc_name: str = "RuntimeError"
+    message: str = "injected fault"
+    hits: int = 0
+    fired: bool = False
+
+    def fire(self) -> None:
+        self.fired = True
+        if self.action == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+            return
+        if self.action == "sigint":
+            os.kill(os.getpid(), signal.SIGINT)
+            return
+        exc_type = getattr(builtins, self.exc_name, None)
+        if not (isinstance(exc_type, type) and issubclass(exc_type, BaseException)):
+            exc_type = RuntimeError
+        raise exc_type(f"{self.message} [injected at {self.point}]")
+
+
+def parse_spec(spec: str) -> List[_Fault]:
+    """``point[@n]=action`` clauses, ``;``-separated.  Raises ValueError
+    on a malformed clause — a typo'd chaos spec must fail the run loudly,
+    not silently test nothing."""
+    out: List[_Fault] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise ValueError(f"fault clause {clause!r}: expected point[@n]=action")
+        target, action = clause.split("=", 1)
+        target, action = target.strip(), action.strip()
+        trigger = 1
+        if "@" in target:
+            target, n = target.rsplit("@", 1)
+            try:
+                trigger = int(n)
+            except ValueError:
+                raise ValueError(f"fault clause {clause!r}: bad trigger count {n!r}")
+            if trigger < 1:
+                raise ValueError(f"fault clause {clause!r}: trigger must be >= 1")
+        if not target:
+            raise ValueError(f"fault clause {clause!r}: empty point name")
+        fault = _Fault(point=target, trigger=trigger)
+        parts = action.split(":", 2)
+        kind = parts[0]
+        if kind in ("sigterm", "sigint"):
+            if len(parts) > 1:
+                raise ValueError(f"fault clause {clause!r}: {kind} takes no arguments")
+            fault.action = kind
+        elif kind == "raise":
+            fault.action = "raise"
+            if len(parts) > 1 and parts[1]:
+                fault.exc_name = parts[1]
+            if len(parts) > 2:
+                fault.message = parts[2]
+        else:
+            raise ValueError(
+                f"fault clause {clause!r}: unknown action {kind!r} "
+                "(want raise[:Exc[:msg]] | sigterm | sigint)"
+            )
+        out.append(fault)
+    return out
+
+
+def configure(spec: Optional[str]) -> None:
+    """Arm the fault set from a spec string (None/"" disarms).  Replaces
+    any previous configuration, including one loaded from the env."""
+    global _armed, _env_loaded
+    with _lock:
+        _faults.clear()
+        _env_loaded = True  # explicit configure wins over the env var
+        for fault in parse_spec(spec) if spec else []:
+            _faults.setdefault(fault.point, []).append(fault)
+        _armed = bool(_faults)
+
+
+def reset() -> None:
+    """Disarm everything and forget that the env was ever read (tests)."""
+    global _armed, _env_loaded
+    with _lock:
+        _faults.clear()
+        _armed = False
+        _env_loaded = False
+
+
+def active() -> bool:
+    _ensure_env_loaded()
+    return _armed
+
+
+def describe() -> List[str]:
+    """Armed, not-yet-fired clauses (for startup logging)."""
+    _ensure_env_loaded()
+    with _lock:
+        return [
+            f"{f.point}@{f.trigger}={f.action}"
+            for fs in _faults.values()
+            for f in fs
+            if not f.fired
+        ]
+
+
+def _ensure_env_loaded() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    with _lock:
+        if _env_loaded:
+            return
+        spec = os.environ.get(_ENV_VAR)
+    if spec is not None:
+        configure(spec)
+    else:
+        global _armed
+        _env_loaded = True
+        _armed = False
+
+
+def fault_point(name: str) -> None:
+    """Mark an injection point.  No-op unless a configured fault targets
+    ``name`` and this hit reaches its trigger count; then the fault fires
+    (raise or signal) exactly once and disarms."""
+    if not _env_loaded:
+        _ensure_env_loaded()
+    if not _armed:
+        return
+    to_fire = None
+    with _lock:
+        for fault in _faults.get(name, ()):
+            if fault.fired:
+                continue
+            fault.hits += 1
+            if fault.hits >= fault.trigger:
+                to_fire = fault
+                break
+    if to_fire is not None:
+        to_fire.fire()  # outside the lock: a handler may hit another point

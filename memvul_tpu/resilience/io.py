@@ -1,0 +1,41 @@
+"""Atomic small-file writes.
+
+A bare ``Path.write_text`` killed mid-write leaves a torn file — half a
+JSON object where a resume path expects metadata.  Everything that must
+survive a kill (metrics sidecars, checksum manifests, preemption
+markers) goes through :func:`atomic_write_text`: write a tmp file in
+the same directory, then ``os.replace`` it into place.  The rename is
+atomic on POSIX, so readers only ever see the old content or the new —
+the same commit pattern orbax uses for whole checkpoint directories.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Union
+
+from . import faults
+
+
+def atomic_write_text(path: Union[str, Path], text: str) -> Path:
+    """Write ``text`` to ``path`` via tmp-file + ``os.replace``.
+
+    The ``ckpt.write`` fault point sits in the torn-write window (tmp
+    written, not yet renamed) so chaos tests can prove a failure there
+    leaves the previous file intact; an injected exception also cleans
+    its own tmp file (a hard kill may leave tmp litter, which is inert —
+    nothing ever reads ``*.tmp.<pid>`` files)."""
+    path = Path(path)
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    try:
+        tmp.write_text(text)
+        faults.fault_point("ckpt.write")
+        os.replace(tmp, path)
+    finally:
+        if tmp.exists():  # a fault/crash between write and replace
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+    return path

@@ -1,0 +1,222 @@
+"""Progress journal + dead-letter quarantine for restartable scoring.
+
+A corpus-scoring pass (``SiamesePredictor.predict_file``) writes one
+output line per batch.  The journal is an **append-only JSONL sidecar**
+(``<out>.journal``) recording, per committed output line:
+
+    {"line": <0-based output line index>,
+     "rows": [[start, end), ...]  # stream indices of the reports scored,
+     "n": <row count>,
+     "sha256": <hex digest of the output line text, newline excluded>}
+
+On restart, :meth:`ScoreJournal.verified_prefix` replays the journal
+against the output file and keeps the longest prefix whose lines hash
+clean — a torn final line (killed mid-write) or a journal entry whose
+output line never landed simply falls off the end and its rows are
+re-scored.  The surviving rows are skipped in the input stream and the
+surviving output lines are fed back into the metrics accumulator, so a
+resumed run finishes with **identical final metrics** to an
+uninterrupted one.
+
+The dead-letter file (``<out>.deadletter``) quarantines records the
+stream cannot score — unparseable JSON lines, records that blow up
+normalization, over-long texts — one JSON line each with the reason, so
+a single corrupt record at report 900k costs one journal line instead
+of the run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple, Union
+
+logger = logging.getLogger(__name__)
+
+# refuse to tokenize texts beyond this many chars: the tokenizer's cost is
+# superlinear in pathological inputs and a single 100MB "report" (a dump
+# pasted into an issue body) would stall the whole stream
+DEFAULT_MAX_TEXT_CHARS = 1_000_000
+
+
+def line_digest(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def to_spans(indices: Iterable[int]) -> List[List[int]]:
+    """Sorted indices → minimal [start, end) spans (journal compression:
+    un-bucketed streams are contiguous, bucketed ones near-contiguous)."""
+    spans: List[List[int]] = []
+    for i in sorted(indices):
+        if spans and i == spans[-1][1]:
+            spans[-1][1] = i + 1
+        else:
+            spans.append([i, i + 1])
+    return spans
+
+
+def from_spans(spans: Iterable[Sequence[int]]) -> Set[int]:
+    out: Set[int] = set()
+    for start, end in spans:
+        out.update(range(int(start), int(end)))
+    return out
+
+
+class DeadLetter:
+    """Append-only quarantine for malformed/over-long records."""
+
+    def __init__(
+        self,
+        path: Union[str, Path],
+        max_text_chars: int = DEFAULT_MAX_TEXT_CHARS,
+    ) -> None:
+        self.path = Path(path)
+        self.max_text_chars = max_text_chars
+        self.count = 0
+        self._f = None
+
+    def record(
+        self,
+        reason: str,
+        raw: Optional[str] = None,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if self._f is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._f = open(self.path, "w", encoding="utf-8")
+        entry: Dict[str, Any] = {"reason": reason}
+        if raw is not None:
+            entry["raw"] = raw[:2000]  # enough to identify, never a 100MB dump
+        if meta:
+            entry["meta"] = meta
+        self._f.write(json.dumps(entry, default=str) + "\n")
+        self._f.flush()
+        self.count += 1
+        logger.warning("dead-letter: %s", reason)
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class ScoreJournal:
+    """Append-only progress journal beside a scoring output file."""
+
+    def __init__(self, path: Union[str, Path]) -> None:
+        self.path = Path(path)
+        self._f = None
+        self.entries_written = 0  # verified-resumed + appended this run
+
+    # -- resume side ---------------------------------------------------------
+
+    def read_entries(self) -> List[Dict[str, Any]]:
+        """All parseable journal entries, in order.  A torn final line
+        (the kill window) is dropped silently; a torn line anywhere else
+        ends the trusted prefix there."""
+        if not self.path.exists():
+            return []
+        entries: List[Dict[str, Any]] = []
+        lines = self.path.read_text(encoding="utf-8").splitlines()
+        for i, line in enumerate(lines):
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                if i != len(lines) - 1:
+                    logger.warning(
+                        "journal %s: unparseable entry at line %d — "
+                        "trusting only the %d entries before it",
+                        self.path, i, len(entries),
+                    )
+                break
+            if not isinstance(entry, dict) or "sha256" not in entry:
+                break
+            entries.append(entry)
+        return entries
+
+    def verified_prefix(
+        self, out_path: Union[str, Path]
+    ) -> Tuple[int, Set[int], List[str]]:
+        """Check the journal against the output file.
+
+        Returns ``(n_lines, completed_rows, kept_lines)``: the number of
+        output lines whose checksums verify against the journal (in
+        order, no gaps), the set of input-stream row indices those lines
+        cover, and the verified line texts (newline-stripped) for
+        replaying into the metrics accumulator.
+        """
+        entries = self.read_entries()
+        out_path = Path(out_path)
+        if not entries or not out_path.exists():
+            return 0, set(), []
+        with open(out_path, encoding="utf-8") as f:
+            out_lines = f.read().splitlines()
+        kept: List[str] = []
+        completed: Set[int] = set()
+        for i, entry in enumerate(entries):
+            if entry.get("line") != i:
+                logger.warning(
+                    "journal %s: entry %d indexes line %s — stopping the "
+                    "verified prefix here", self.path, i, entry.get("line"),
+                )
+                break
+            if i >= len(out_lines) or line_digest(out_lines[i]) != entry["sha256"]:
+                logger.warning(
+                    "journal %s: output line %d missing or checksum-"
+                    "mismatched (torn write?) — re-scoring from there",
+                    self.path, i,
+                )
+                break
+            kept.append(out_lines[i])
+            completed |= from_spans(entry.get("rows", ()))
+        return len(kept), completed, kept
+
+    def truncate_to(self, n_entries: int, out_path: Union[str, Path]) -> None:
+        """Drop everything past the verified prefix: rewrite the journal
+        to its first ``n_entries`` entries (atomically) and truncate the
+        output file to the matching byte length."""
+        from .io import atomic_write_text
+
+        entries = self.read_entries()[:n_entries]
+        atomic_write_text(
+            self.path, "".join(json.dumps(e) + "\n" for e in entries)
+        )
+        out_path = Path(out_path)
+        if out_path.exists():
+            keep_bytes = 0
+            with open(out_path, "rb") as f:
+                for _ in range(n_entries):
+                    line = f.readline()
+                    if not line:
+                        break
+                    keep_bytes += len(line)
+            with open(out_path, "r+b") as f:
+                f.truncate(keep_bytes)
+        self.entries_written = n_entries
+
+    # -- writer side ---------------------------------------------------------
+
+    def append(self, line_index: int, rows: Iterable[int], line_text: str) -> None:
+        """Record one committed output line.  The caller must have
+        flushed the output line to its file first — the journal entry is
+        the durable claim that the line landed."""
+        if self._f is None:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._f = open(self.path, "a", encoding="utf-8")
+        rows = list(rows)
+        entry = {
+            "line": line_index,
+            "rows": to_spans(rows),
+            "n": len(rows),
+            "sha256": line_digest(line_text),
+        }
+        self._f.write(json.dumps(entry) + "\n")
+        self._f.flush()
+        self.entries_written += 1
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

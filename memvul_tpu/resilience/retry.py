@@ -1,0 +1,94 @@
+"""Shared transient-failure classification + retry/backoff policy.
+
+Generalized from the bench supervisor's private ``_RETRYABLE_MARKERS``
+and backoff loop (``memvul_tpu/bench.py:_supervise``) so the bench, the
+corpus-scoring path, and any future long-running job agree on what
+"transient" means: a backend that answers ``UNAVAILABLE`` to the bench
+is the same backend that will throw it mid-stream at batch 900k of a
+scoring run, and both must burn a retry rather than the whole job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, Optional, Sequence, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+# Substrings marking a transient backend failure worth retrying (the
+# round-2 bench capture died with the first one).  A watchdog
+# phase-timeout is retryable too: a phase that stops making progress
+# mid-run is the silently-wedged-backend signature, same as a hung
+# first device op.
+RETRYABLE_MARKERS = (
+    "UNAVAILABLE",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "Socket closed",
+    "failed to connect",
+    "watchdog: phase",
+)
+
+
+def exception_text(exc: BaseException) -> str:
+    """The string the markers are matched against for an in-process
+    exception — type name + message, mirroring what a child process
+    would have printed to stderr."""
+    return f"{type(exc).__name__}: {exc}"
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Attempts + linear backoff + the shared transient classification.
+
+    ``delay(attempt)`` reproduces the bench supervisor's schedule
+    (``backoff * attempt`` seconds after the attempt-th failure), so
+    moving the supervisor onto this policy is behavior-preserving.
+    """
+
+    attempts: int = 3
+    backoff: float = 2.0
+    markers: Sequence[str] = RETRYABLE_MARKERS
+    sleep: Callable[[float], None] = time.sleep
+
+    def is_transient(self, text: str) -> bool:
+        return any(m in text for m in self.markers)
+
+    def delay(self, attempt: int) -> float:
+        return self.backoff * attempt
+
+    def call(
+        self,
+        fn: Callable[[], T],
+        description: str = "operation",
+        on_retry: Optional[Callable[[BaseException, int], None]] = None,
+    ) -> T:
+        """Run ``fn`` with up to ``attempts`` tries.  Only exceptions
+        whose text matches a transient marker are retried; anything else
+        (a genuine bug) propagates immediately without burning retries —
+        the same fail-fast contract as the bench supervisor."""
+        last: Optional[BaseException] = None
+        for attempt in range(1, max(1, self.attempts) + 1):
+            try:
+                return fn()
+            except BaseException as e:
+                if not self.is_transient(exception_text(e)):
+                    raise
+                last = e
+                if attempt >= self.attempts:
+                    break
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                logger.warning(
+                    "%s failed transiently (%s); retry %d/%d in %.0fs",
+                    description, exception_text(e)[:200],
+                    attempt, self.attempts - 1, self.delay(attempt),
+                )
+                self.sleep(self.delay(attempt))
+        assert last is not None
+        raise last

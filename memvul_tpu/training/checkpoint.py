@@ -10,19 +10,36 @@ reference (model_memory.py:76-77, predict_memory.py:78-83).
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import shutil
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..resilience import faults
+from ..resilience.io import atomic_write_text
+
+logger = logging.getLogger(__name__)
+
 
 class TrainCheckpointer:
-    """Tracks 'latest' and 'best' training state under one directory."""
+    """Tracks 'latest' and 'best' training state under one directory.
 
-    def __init__(self, directory: Union[str, Path], max_to_keep: int = 1) -> None:
+    Two checkpoint families share it: per-**epoch** state (the original
+    contract) and mid-epoch per-**step** state (preemption saves /
+    ``save_every_steps``), each with its own orbax manager under
+    ``epochs/`` and ``steps/``.  Every committed checkpoint gets a
+    checksum manifest (``manifest_<family>_<n>.json`` beside the
+    family dir, sha256 per file) that restore verifies — a corrupt
+    newest checkpoint falls back to the previous good one instead of
+    poisoning the resumed run, which is why ``max_to_keep`` defaults to
+    2 (one fallback generation)."""
+
+    def __init__(self, directory: Union[str, Path], max_to_keep: int = 2) -> None:
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         self._manager = ocp.CheckpointManager(
@@ -31,8 +48,17 @@ class TrainCheckpointer:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self._step_manager = ocp.CheckpointManager(
+            self.directory / "steps",
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max(2, max_to_keep), create=True
+            ),
+        )
         self._best_ckptr = ocp.StandardCheckpointer()
         self._best_dir = self.directory / "best"
+        # manifests for async epoch saves are deferred until the write
+        # commits — flush() drains this
+        self._pending_manifests: List[int] = []
 
     # -- per-epoch state -----------------------------------------------------
 
@@ -53,9 +79,13 @@ class TrainCheckpointer:
         leaves the previous checkpoint intact."""
         self.flush()
         self._manager.save(step, args=ocp.args.StandardSave(state))
+        self._pending_manifests.append(step)
         if metadata is not None:
-            (self.directory / f"metrics_epoch_{step}.json").write_text(
-                json.dumps(metadata, indent=2, default=float)
+            # tmp + os.replace: a kill mid-write must leave the previous
+            # metrics file (or none), never a torn JSON half
+            atomic_write_text(
+                self.directory / f"metrics_epoch_{step}.json",
+                json.dumps(metadata, indent=2, default=float),
             )
         if is_best:
             # the best checkpoint swaps via rename-aside: write the
@@ -108,24 +138,158 @@ class TrainCheckpointer:
             old.rename(self._best_dir)
 
     def flush(self) -> None:
-        """Block until all in-flight checkpoint writes are committed."""
+        """Block until all in-flight checkpoint writes are committed,
+        then stamp their checksum manifests (a manifest is only valid
+        once the directory it hashes is final)."""
         self._manager.wait_until_finished()
+        self._step_manager.wait_until_finished()
         self._best_ckptr.wait_until_finished()
+        for step in self._pending_manifests:
+            self._write_manifest("epochs", step)
+        self._pending_manifests.clear()
+        self._prune_stale_manifests()
+
+    # -- checksum manifests --------------------------------------------------
+
+    def _manifest_path(self, family: str, step: int) -> Path:
+        return self.directory / f"manifest_{family}_{step}.json"
+
+    def _checkpoint_dir(self, family: str, step: int) -> Path:
+        return self.directory / family / str(step)
+
+    def _write_manifest(self, family: str, step: int) -> None:
+        root = self._checkpoint_dir(family, step)
+        if not root.exists():  # GC'd by max_to_keep before the flush
+            return
+        files = {}
+        for p in sorted(root.rglob("*")):
+            if p.is_file():
+                files[str(p.relative_to(root))] = hashlib.sha256(
+                    p.read_bytes()
+                ).hexdigest()
+        atomic_write_text(
+            self._manifest_path(family, step),
+            json.dumps({"family": family, "step": step, "files": files}, indent=2),
+        )
+
+    def verify_manifest(self, family: str, step: int) -> bool:
+        """True when every file the manifest records hashes clean.  A
+        missing manifest passes (checkpoints written before manifests
+        existed must stay restorable) — corruption detection needs the
+        manifest to have landed, which flush() guarantees for every
+        committed save."""
+        mpath = self._manifest_path(family, step)
+        if not mpath.exists():
+            return True
+        try:
+            manifest = json.loads(mpath.read_text())
+        except ValueError:
+            logger.warning("manifest %s is unreadable — treating %s/%d as "
+                           "corrupt", mpath, family, step)
+            return False
+        root = self._checkpoint_dir(family, step)
+        for rel, digest in manifest.get("files", {}).items():
+            p = root / rel
+            if not p.is_file() or hashlib.sha256(p.read_bytes()).hexdigest() != digest:
+                logger.warning(
+                    "checkpoint %s/%d failed checksum verification at %s",
+                    family, step, rel,
+                )
+                return False
+        return True
+
+    def _prune_stale_manifests(self) -> None:
+        """Manifests of checkpoints orbax GC'd under max_to_keep."""
+        live = {
+            "epochs": set(self._manager.all_steps()),
+            "steps": set(self._step_manager.all_steps()),
+        }
+        for mpath in self.directory.glob("manifest_*_*.json"):
+            try:
+                _, family, step = mpath.stem.split("_", 2)
+                if int(step) not in live.get(family, set()):
+                    mpath.unlink()
+                    meta = self.directory / f"step_meta_{step}.json"
+                    if family == "steps" and meta.exists():
+                        meta.unlink()
+            except (ValueError, OSError):
+                continue
 
     def latest_step(self) -> Optional[int]:
         self.flush()
         return self._manager.latest_step()
 
+    def _restore_newest_verified(
+        self, manager: "ocp.CheckpointManager", family: str, template: Dict[str, Any]
+    ) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Newest checkpoint that passes manifest verification; older
+        good generations are the fallback.  A restore error on a
+        manifest-clean checkpoint is NOT treated as corruption — it means
+        the caller's template doesn't match (e.g. ema_decay toggled), and
+        the trainer's alternate-template retry needs to see it."""
+        for step in sorted(manager.all_steps(), reverse=True):
+            if not self.verify_manifest(family, step):
+                logger.warning(
+                    "skipping corrupt %s checkpoint %d — falling back to "
+                    "the previous good one", family, step,
+                )
+                continue
+            return step, manager.restore(
+                step, args=ocp.args.StandardRestore(template)
+            )
+        return None
+
     def restore_latest(
         self, template: Dict[str, Any]
     ) -> Optional[Tuple[int, Dict[str, Any]]]:
-        step = self.latest_step()  # flushes in-flight writes
-        if step is None:
+        self.flush()
+        return self._restore_newest_verified(self._manager, "epochs", template)
+
+    # -- mid-epoch step checkpoints ------------------------------------------
+
+    def save_step(
+        self,
+        step: int,
+        state: Dict[str, Any],
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Synchronous step save: a preemption save must be fully on
+        disk (manifest included) before the process exits, and the
+        periodic ``save_every_steps`` path reuses the same guarantee so
+        a step checkpoint is never half-committed."""
+        faults.fault_point("ckpt.write")
+        self.flush()
+        self._step_manager.save(step, args=ocp.args.StandardSave(state))
+        self._step_manager.wait_until_finished()
+        self._write_manifest("steps", step)
+        if metadata is not None:
+            atomic_write_text(
+                self.directory / f"step_meta_{step}.json",
+                json.dumps(metadata, indent=2, default=float),
+            )
+        self._prune_stale_manifests()
+
+    def step_metadata(self, step: int) -> Optional[Dict[str, Any]]:
+        p = self.directory / f"step_meta_{step}.json"
+        if not p.exists():
             return None
-        restored = self._manager.restore(
-            step, args=ocp.args.StandardRestore(template)
+        try:
+            return json.loads(p.read_text())
+        except ValueError:
+            logger.warning("step metadata %s is torn/unreadable", p)
+            return None
+
+    def latest_step_checkpoint(self) -> Optional[int]:
+        self.flush()
+        return self._step_manager.latest_step()
+
+    def restore_latest_step(
+        self, template: Dict[str, Any]
+    ) -> Optional[Tuple[int, Dict[str, Any]]]:
+        self.flush()
+        return self._restore_newest_verified(
+            self._step_manager, "steps", template
         )
-        return step, restored
 
     def restore_best(self, template: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         self.flush()
@@ -137,6 +301,7 @@ class TrainCheckpointer:
     def close(self) -> None:
         self.flush()
         self._manager.close()
+        self._step_manager.close()
         self._best_ckptr.close()
 
 

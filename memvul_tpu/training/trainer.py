@@ -25,8 +25,11 @@ batches are fixed-shape by construction).
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
+import signal
 import tempfile
+import threading
 import time
 from pathlib import Path
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
@@ -39,6 +42,8 @@ from ..data.batching import LABELS_SIAMESE, CachedEncoder, batches_from_instance
 from ..data.readers import MemoryReader
 from ..models.memory import MemoryModel, pair_loss
 from ..parallel.mesh import replicate, shard_batch
+from ..resilience import faults
+from ..resilience.io import atomic_write_text
 from .checkpoint import MetricTracker, TrainCheckpointer
 from .metrics import RunningClassification, device_confusion, drain_pending
 from .optim import make_optimizer
@@ -193,7 +198,19 @@ class TrainerConfig:
     weight_decay: float = 0.0
     seed: int = 2021
     serialization_dir: Optional[str] = None
-    keep_checkpoints: int = 1
+    # 2, not 1: the checksum-verified restore falls back to the previous
+    # good checkpoint when the newest is corrupt, so one spare
+    # generation must survive GC (docs/fault_tolerance.md)
+    keep_checkpoints: int = 2
+    # periodic mid-epoch step checkpoint (params/opt/rng/EMA + stream
+    # position) every N optimizer steps; None = only on preemption.
+    # Synchronous — size it so the save cost amortizes (e.g. 500-2000
+    # steps on a pod, where an epoch is hours)
+    save_every_steps: Optional[int] = None
+    # append {"step", "loss"} JSON lines here as stats drain — the
+    # machine-readable loss trajectory the kill/resume parity proof (and
+    # any external watchdog) reads
+    step_loss_log: Optional[str] = None
     steps_per_epoch: Optional[int] = None  # cap (useful for tests/smoke)
     # MemVul-o ablation: False freezes the first epoch's pair sample and
     # reuses it every epoch (the reference disables its reset_dataloader
@@ -266,6 +283,10 @@ class MemoryTrainer:
         self.rng = jax.random.PRNGKey(c.seed)
         self.step = 0
         self.epoch = 0
+        # preemption / mid-epoch resume state
+        self._stop_signal: Optional[int] = None
+        self._resume_skip_stacks = 0  # stacks of the current epoch already trained
+        self._epoch_stacks_done = 0
         self.tracker = MetricTracker(c.validation_metric, c.patience)
         self.checkpointer = (
             TrainCheckpointer(c.serialization_dir, c.keep_checkpoints)
@@ -287,14 +308,33 @@ class MemoryTrainer:
 
     # -- data ----------------------------------------------------------------
 
+    def _epoch_seed(self, epoch: int) -> int:
+        """Deterministic per-epoch pair-sampling seed.  Seeding each
+        epoch from (trainer seed, epoch) — instead of letting the
+        reader's RNG free-run across epochs — makes every epoch's stream
+        a pure function of its index, which is what lets a mid-epoch
+        resume replay the interrupted epoch exactly (the prefetch thread
+        over-reads the stream, so the RNG's live state at kill time is
+        not meaningful)."""
+        return (self.config.seed * 1_000_003 + epoch) & 0x7FFFFFFF
+
+    def _reseed_reader(self, epoch: int) -> None:
+        reseed = getattr(self.reader, "reseed", None)
+        if reseed is not None:
+            reseed(self._epoch_seed(epoch))
+
     def _train_instances(self):
         """The epoch's pair stream.  With ``online_resample`` off the first
         epoch's sampled pairs are frozen and replayed every epoch (instances
         are small host dicts; batches/stacks are still rebuilt per epoch so
         nothing epoch-sized is pinned on device)."""
         if self.config.online_resample:
+            self._reseed_reader(self.epoch)
             return self.reader.read(self.train_path, split="train")
         if not hasattr(self, "_frozen_instances"):
+            # the frozen sample is always epoch 0's stream, even when the
+            # freeze happens on a trainer resumed at a later epoch
+            self._reseed_reader(0)
             self._frozen_instances = list(
                 self.reader.read(self.train_path, split="train")
             )
@@ -339,7 +379,15 @@ class MemoryTrainer:
     def _drain_stats(self, pending, running, losses) -> None:
         """One blocking transfer per window; NaN guard fires here
         (reference NaN check: custom_trainer.py:403-404)."""
+        n_before = len(losses)
         drain_pending(pending, _host_fetch, self.step, losses, running)
+        log_path = self.config.step_loss_log
+        if log_path and len(losses) > n_before:
+            new = losses[n_before:]
+            first = self.step - len(new)
+            with open(log_path, "a") as f:
+                for offset, loss in enumerate(new):
+                    f.write(json.dumps({"step": first + offset, "loss": loss}) + "\n")
 
     def train_epoch(self) -> Dict[str, float]:
         c = self.config
@@ -351,10 +399,22 @@ class MemoryTrainer:
         timer = StepTimer()
         started = time.perf_counter()
         trace_dir = c.profile_dir if (c.profile_dir and self.epoch == 0) else None
+        # mid-epoch resume: the epoch's stream is replayed from its
+        # deterministic per-epoch seed and the stacks that were already
+        # trained before the preemption are skipped (they are re-collated
+        # — cheap host work — but never re-trained)
+        skip = self._resume_skip_stacks
+        self._resume_skip_stacks = 0
+        self._epoch_stacks_done = skip
         with trace_context(trace_dir):
             for i, stack in enumerate(self._microbatch_stacks()):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
+                if i < skip:
+                    continue
+                # chaos hook: "step.<global step index>" fires at the
+                # start of the step (docs/fault_tolerance.md)
+                faults.fault_point(f"step.{self.step}")
                 with timer.step():
                     if self.ema_params is not None:
                         (
@@ -372,9 +432,29 @@ class MemoryTrainer:
                         )
                     pending.append(stats)
                     self.step += 1
+                self._epoch_stacks_done = i + 1
                 if len(pending) >= max(1, c.sync_every):
                     with timer.distribute_over_last(len(pending)):
                         self._drain_stats(pending, running, losses)
+                if (
+                    c.save_every_steps
+                    and self.checkpointer is not None
+                    and self.step % c.save_every_steps == 0
+                ):
+                    with timer.distribute_over_last(max(1, len(pending))):
+                        self._drain_stats(pending, running, losses)
+                    self._save_step_checkpoint()
+                if self._stop_signal is not None:
+                    # the in-flight step above completed; leave the rest
+                    # of the epoch to the resumed run
+                    logger.warning(
+                        "stop signal %s: halting after step %d "
+                        "(%d/%s stacks of epoch %d)",
+                        self._stop_signal, self.step - 1,
+                        self._epoch_stacks_done,
+                        c.steps_per_epoch or "?", self.epoch,
+                    )
+                    break
             if pending:
                 with timer.distribute_over_last(len(pending)):
                     self._drain_stats(pending, running, losses)
@@ -433,44 +513,146 @@ class MemoryTrainer:
             rename.get(k, f"s_{k}"): v for k, v in metrics.items()
         }
 
+    # -- preemption safety -----------------------------------------------------
+
+    def _request_stop(self, signum, frame) -> None:
+        """Signal handler: flag only.  The in-flight step finishes, the
+        epoch loop drains its stats window, and the trainer exits through
+        a step checkpoint — never mid-update."""
+        self._stop_signal = signum
+
+    def _install_signal_handlers(self):
+        """SIGTERM (the preemption notice on managed pods) and SIGINT
+        route through :meth:`_request_stop` while train() runs.  Only
+        possible from the main thread — elsewhere (tests driving the
+        trainer from a worker thread) training simply runs unguarded."""
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        previous = []
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                previous.append((sig, signal.signal(sig, self._request_stop)))
+            except (ValueError, OSError):  # exotic embedding
+                pass
+        return previous
+
+    @property
+    def _preempt_marker(self) -> Optional[Path]:
+        if self.config.serialization_dir is None:
+            return None
+        return Path(self.config.serialization_dir) / "PREEMPTED.json"
+
+    def _save_step_checkpoint(self) -> None:
+        """Synchronous mid-epoch checkpoint: full optimizer state plus the
+        host stream position (epoch index + stacks consumed), enough to
+        replay the rest of the epoch exactly."""
+        if self.checkpointer is None:
+            return
+        self.checkpointer.save_step(
+            self.step,
+            self._state_dict(),
+            metadata={
+                "epoch": self.epoch,
+                "step": self.step,
+                "stacks_done": self._epoch_stacks_done,
+                "epoch_seed": self._epoch_seed(self.epoch),
+                "signal": self._stop_signal,
+            },
+        )
+        logger.info(
+            "step checkpoint: global step %d (epoch %d, %d stacks done)",
+            self.step, self.epoch, self._epoch_stacks_done,
+        )
+
+    def _save_preemption_state(self) -> None:
+        self._save_step_checkpoint()
+        marker = self._preempt_marker
+        if marker is not None:
+            atomic_write_text(
+                marker,
+                json.dumps(
+                    {
+                        "signal": self._stop_signal,
+                        "epoch": self.epoch,
+                        "step": self.step,
+                        "stacks_done": self._epoch_stacks_done,
+                    },
+                    indent=2,
+                ),
+            )
+        logger.warning(
+            "preempted by signal %s at step %d — resumable state saved",
+            self._stop_signal, self.step,
+        )
+
     def train(self) -> Dict[str, Any]:
         c = self.config
         self.maybe_restore()
-        while self.epoch < c.num_epochs:
-            epoch_metrics = {"epoch": self.epoch}
-            epoch_metrics.update(
-                {f"training_{k}": v for k, v in self.train_epoch().items()}
-            )
-            val = self.validate()
-            epoch_metrics.update({f"validation_{k}": v for k, v in val.items()})
-            self.metrics_history.append(epoch_metrics)
-            logger.info("epoch %d: %s", self.epoch, epoch_metrics)
+        handlers = self._install_signal_handlers()
+        preempted = False
+        try:
+            while self.epoch < c.num_epochs:
+                if self._stop_signal is not None:  # signal between epochs
+                    preempted = True
+                    self._save_preemption_state()
+                    break
+                epoch_metrics = {"epoch": self.epoch}
+                train_metrics = self.train_epoch()
+                if self._stop_signal is not None:
+                    # partial epoch: no validation, no epoch checkpoint,
+                    # no tracker update — the resumed run finishes the
+                    # epoch and produces the real epoch metrics
+                    preempted = True
+                    self._save_preemption_state()
+                    break
+                epoch_metrics.update(
+                    {f"training_{k}": v for k, v in train_metrics.items()}
+                )
+                val = self.validate()
+                epoch_metrics.update({f"validation_{k}": v for k, v in val.items()})
+                self.metrics_history.append(epoch_metrics)
+                logger.info("epoch %d: %s", self.epoch, epoch_metrics)
 
-            is_best = True
-            if val:
-                is_best = self.tracker.update(
-                    {k.replace("validation_", ""): v for k, v in epoch_metrics.items()
-                     if k.startswith("validation_")},
-                    self.epoch,
-                )
-            if self.checkpointer is not None:
-                self.checkpointer.save(
-                    self.epoch,
-                    self._state_dict(),
-                    is_best=is_best,
-                    metadata=epoch_metrics,
-                )
-            self.epoch += 1
-            if val and self.tracker.should_stop():
-                logger.info("early stopping at epoch %d", self.epoch)
-                break
+                is_best = True
+                if val:
+                    is_best = self.tracker.update(
+                        {k.replace("validation_", ""): v for k, v in epoch_metrics.items()
+                         if k.startswith("validation_")},
+                        self.epoch,
+                    )
+                if self.checkpointer is not None:
+                    self.checkpointer.save(
+                        self.epoch,
+                        self._state_dict(),
+                        is_best=is_best,
+                        metadata=epoch_metrics,
+                    )
+                self.epoch += 1
+                self._epoch_stacks_done = 0
+                if val and self.tracker.should_stop():
+                    logger.info("early stopping at epoch %d", self.epoch)
+                    break
+        finally:
+            if handlers:
+                for sig, old in handlers:
+                    try:
+                        signal.signal(sig, old)
+                    except (ValueError, OSError):
+                        pass
         if self.checkpointer is not None:
             self.checkpointer.flush()  # final async save must land on disk
-        return {
+        marker = self._preempt_marker
+        if not preempted and marker is not None and marker.exists():
+            marker.unlink()  # completed cleanly: the resumable marker is stale
+        result: Dict[str, Any] = {
             "best_epoch": self.tracker.best_epoch,
             "best_validation": self.tracker.best,
             "history": self.metrics_history,
         }
+        if preempted:
+            result["preempted"] = True
+            result["preempt_signal"] = self._stop_signal
+        return result
 
     # -- state ----------------------------------------------------------------
 
@@ -482,6 +664,9 @@ class MemoryTrainer:
             "meta": {
                 "step": self.step,
                 "epoch": self.epoch,
+                # stream position within the (possibly partial) epoch —
+                # meaningful for step checkpoints, full-epoch for epoch ones
+                "stacks_done": self._epoch_stacks_done,
                 "tracker": self.tracker.state_dict(),
             },
         }
@@ -502,18 +687,39 @@ class MemoryTrainer:
             alt["ema_params"] = jax.device_get(self.params)
         return full, alt
 
-    def maybe_restore(self) -> bool:
-        if self.checkpointer is None:
-            return False
+    def _try_restore(self, restore_fn):
+        """Run a checkpointer restore with the ema-toggle template
+        fallback (shared by the epoch and step paths)."""
         full, alt = self._restore_templates()
         try:
-            restored = self.checkpointer.restore_latest(full)
+            return restore_fn(full)
         except Exception:
             logger.warning(
                 "checkpoint structure mismatch (ema_decay toggled?) — "
                 "retrying with the alternate template"
             )
-            restored = self.checkpointer.restore_latest(alt)
+            return restore_fn(alt)
+
+    def maybe_restore(self) -> bool:
+        if self.checkpointer is None:
+            return False
+        restored = self._try_restore(self.checkpointer.restore_latest)
+        step_restored = self._try_restore(self.checkpointer.restore_latest_step)
+        # a step checkpoint belongs to an epoch still in progress when it
+        # was written; it wins only if no epoch checkpoint completed that
+        # epoch afterwards
+        completed_epoch = restored[0] if restored is not None else -1
+        mid_epoch = False
+        if step_restored is not None:
+            step_epoch = int(step_restored[1]["meta"]["epoch"])
+            if step_epoch > completed_epoch:
+                restored = step_restored
+                mid_epoch = True
+            else:
+                logger.info(
+                    "ignoring stale step checkpoint from epoch %d "
+                    "(epoch %d completed after it)", step_epoch, completed_epoch,
+                )
         if restored is None:
             return False
         _, state = restored
@@ -529,7 +735,14 @@ class MemoryTrainer:
                 self.ema_params = jax.tree_util.tree_map(jnp.copy, self.params)
         meta = state["meta"]
         self.step = int(meta["step"])
-        self.epoch = int(meta["epoch"]) + 1  # resume after the saved epoch
+        if mid_epoch:
+            # resume INSIDE the interrupted epoch: replay its stream and
+            # skip the stacks that were already trained
+            self.epoch = int(meta["epoch"])
+            self._resume_skip_stacks = int(meta.get("stacks_done", 0))
+        else:
+            self.epoch = int(meta["epoch"]) + 1  # resume after the saved epoch
+            self._resume_skip_stacks = 0
         tracker_state = dict(meta["tracker"])
         self.tracker.load_state_dict(tracker_state)
         # reload per-epoch metrics history from the JSON sidecars so
@@ -545,7 +758,14 @@ class MemoryTrainer:
         if self.mesh is not None:
             self.params = replicate(self.params, self.mesh)
             self.opt_state = replicate(self.opt_state, self.mesh)
-        logger.info("restored checkpoint at epoch %d", self.epoch - 1)
+        if mid_epoch:
+            logger.info(
+                "restored mid-epoch step checkpoint: resuming epoch %d at "
+                "stack %d (global step %d)",
+                self.epoch, self._resume_skip_stacks, self.step,
+            )
+        else:
+            logger.info("restored checkpoint at epoch %d", self.epoch - 1)
         return True
 
     def best_params(self):

@@ -7,9 +7,11 @@ a crash at any instant leaves a committed best under ``best`` or
 recovery.
 """
 
+import json
+
 import numpy as np
 
-from memvul_tpu.training.checkpoint import TrainCheckpointer
+from memvul_tpu.training.checkpoint import MetricTracker, TrainCheckpointer
 
 
 def _state(v: float):
@@ -119,3 +121,135 @@ def test_restore_best_none_when_never_saved(tmp_path):
     ck = TrainCheckpointer(tmp_path / "ck")
     assert ck.restore_best(_state(0.0)) is None
     ck.close()
+
+
+# -- checksum manifests + corrupt-fallback -----------------------------------
+
+
+def _corrupt_one_payload(ckpt_dir):
+    """Flip bytes in the first non-metadata payload file of an orbax
+    checkpoint dir (what a torn disk write / bit rot looks like)."""
+    for f in sorted(ckpt_dir.rglob("*")):
+        if f.is_file() and f.stat().st_size > 8 and "METADATA" not in f.name:
+            f.write_bytes(b"\xde\xad\xbe\xef" + f.read_bytes()[4:])
+            return f
+    raise AssertionError(f"no payload file found under {ckpt_dir}")
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    ck = TrainCheckpointer(tmp_path / "ck", max_to_keep=2)
+    ck.save(0, _state(1.0))
+    ck.flush()
+    manifest = json.loads((tmp_path / "ck" / "manifest_epochs_0.json").read_text())
+    assert manifest["files"], "manifest recorded no files"
+    assert ck.verify_manifest("epochs", 0)
+    ck.close()
+
+
+def test_restore_latest_falls_back_past_corrupt_newest(tmp_path):
+    """The newest checkpoint fails its checksum manifest → restore_latest
+    returns the previous good generation instead of poisoned state (this
+    is why max_to_keep defaults to 2)."""
+    ck = TrainCheckpointer(tmp_path / "ck", max_to_keep=2)
+    ck.save(0, _state(1.0))
+    ck.save(1, _state(2.0))
+    ck.flush()
+    _corrupt_one_payload(tmp_path / "ck" / "epochs" / "1")
+    assert not ck.verify_manifest("epochs", 1)
+    restored = ck.restore_latest(_state(0.0))
+    ck.close()
+    assert restored is not None
+    step, state = restored
+    assert step == 0
+    np.testing.assert_array_equal(state["w"], np.full((4,), 1.0))
+
+
+def test_step_checkpoint_roundtrip_with_metadata(tmp_path):
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck.save_step(7, _state(3.0), metadata={"epoch": 1, "stacks_done": 4})
+    assert ck.latest_step_checkpoint() == 7
+    assert ck.verify_manifest("steps", 7)
+    assert ck.step_metadata(7) == {"epoch": 1, "stacks_done": 4}
+    step, state = ck.restore_latest_step(_state(0.0))
+    ck.close()
+    assert step == 7
+    np.testing.assert_array_equal(state["w"], np.full((4,), 3.0))
+
+
+def test_step_restore_falls_back_past_corrupt_newest(tmp_path):
+    ck = TrainCheckpointer(tmp_path / "ck", max_to_keep=2)
+    ck.save_step(4, _state(4.0))
+    ck.save_step(8, _state(8.0))
+    _corrupt_one_payload(tmp_path / "ck" / "steps" / "8")
+    step, state = ck.restore_latest_step(_state(0.0))
+    ck.close()
+    assert step == 4
+    np.testing.assert_array_equal(state["w"], np.full((4,), 4.0))
+
+
+def test_metadata_sidecar_written_atomically(tmp_path):
+    """metrics_epoch_N.json goes through the tmp+os.replace helper: no
+    torn halves, no tmp litter left beside it."""
+    ck = TrainCheckpointer(tmp_path / "ck")
+    ck.save(0, _state(1.0), metadata={"loss": 0.5})
+    ck.flush()
+    assert json.loads((tmp_path / "ck" / "metrics_epoch_0.json").read_text()) == {
+        "loss": 0.5
+    }
+    assert list((tmp_path / "ck").glob("*.tmp.*")) == []
+    ck.close()
+
+
+def test_stale_manifests_pruned_with_gc(tmp_path):
+    """max_to_keep GC deletes old checkpoint dirs; their manifests must
+    not outlive them (a stale manifest could veto a fresh step number)."""
+    ck = TrainCheckpointer(tmp_path / "ck", max_to_keep=2)
+    for i in range(4):
+        ck.save(i, _state(float(i)))
+    ck.flush()
+    live = {p.name for p in (tmp_path / "ck").glob("manifest_epochs_*.json")}
+    assert live == {"manifest_epochs_2.json", "manifest_epochs_3.json"}
+    ck.close()
+
+
+# -- MetricTracker resume semantics ------------------------------------------
+
+
+def test_metric_tracker_state_roundtrip_preserves_patience():
+    """Early stopping must fire at the SAME epoch whether or not the
+    tracker was serialized/restored mid-run — the trainer-resume
+    contract for patience counting."""
+    values = [0.5, 0.6, 0.55, 0.58, 0.59, 0.52]  # best at epoch 1
+    uninterrupted = MetricTracker("+s_f1-score", patience=3)
+    stop_epoch = None
+    for epoch, v in enumerate(values):
+        uninterrupted.update({"s_f1-score": v}, epoch)
+        if uninterrupted.should_stop():
+            stop_epoch = epoch
+            break
+    assert stop_epoch == 4  # 3 epochs without improvement after epoch 1
+
+    resumed = MetricTracker("+s_f1-score", patience=3)
+    for epoch, v in enumerate(values):
+        resumed.update({"s_f1-score": v}, epoch)
+        # checkpoint/restore between EVERY epoch
+        fresh = MetricTracker("+s_f1-score", patience=3)
+        fresh.load_state_dict(json.loads(json.dumps(resumed.state_dict())))
+        resumed = fresh
+        if resumed.should_stop():
+            assert epoch == stop_epoch
+            break
+    else:
+        raise AssertionError("restored tracker never fired early stopping")
+    assert resumed.best_epoch == uninterrupted.best_epoch == 1
+    assert resumed.best == uninterrupted.best
+
+
+def test_metric_tracker_roundtrip_through_json_with_none_best():
+    """A tracker checkpointed before its first validation (best=None)
+    must survive the JSON round-trip the step-metadata sidecar uses."""
+    t = MetricTracker("-loss", patience=2)
+    restored = MetricTracker("-loss", patience=2)
+    restored.load_state_dict(json.loads(json.dumps(t.state_dict())))
+    assert restored.best is None and restored.epochs_without_improvement == 0
+    assert restored.update({"loss": 1.0}, 0) is True

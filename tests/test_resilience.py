@@ -1,0 +1,297 @@
+"""Unit tests for the resilience layer (memvul_tpu/resilience/).
+
+No models here — these pin the building blocks (fault spec parsing,
+one-shot firing, transient classification, retry/backoff, atomic
+writes, journal verification) that the chaos tests in
+tests/test_fault_tolerance.py drive end-to-end through the trainer and
+the scoring path.
+"""
+
+import json
+import signal
+
+import pytest
+
+from memvul_tpu.resilience import faults
+from memvul_tpu.resilience.io import atomic_write_text
+from memvul_tpu.resilience.journal import (
+    DeadLetter,
+    ScoreJournal,
+    from_spans,
+    line_digest,
+    to_spans,
+)
+from memvul_tpu.resilience.retry import (
+    RETRYABLE_MARKERS,
+    RetryPolicy,
+    exception_text,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- fault injection ----------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    fs = faults.parse_spec(
+        "score.batch@3=raise:RuntimeError:UNAVAILABLE injected; step.4=sigterm"
+    )
+    assert len(fs) == 2
+    assert fs[0].point == "score.batch" and fs[0].trigger == 3
+    assert fs[0].exc_name == "RuntimeError"
+    assert "UNAVAILABLE" in fs[0].message
+    assert fs[1].point == "step.4" and fs[1].action == "sigterm"
+    assert fs[1].trigger == 1
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "no_equals_sign",
+        "point@x=raise",
+        "point@0=raise",
+        "=raise",
+        "point=explode",
+        "point=sigterm:arg",
+    ],
+)
+def test_fault_spec_rejects_malformed(bad):
+    """A typo'd chaos spec must fail loudly, not silently test nothing."""
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_fault_point_noop_when_unconfigured():
+    faults.configure(None)
+    for _ in range(100):
+        faults.fault_point("score.batch")  # must not raise
+
+
+def test_fault_fires_at_trigger_count_then_disarms():
+    faults.configure("score.batch@3=raise:ValueError:boom")
+    faults.fault_point("score.batch")
+    faults.fault_point("score.batch")
+    with pytest.raises(ValueError, match="boom"):
+        faults.fault_point("score.batch")
+    # one-shot: the retry that follows the injected failure succeeds
+    faults.fault_point("score.batch")
+    faults.fault_point("score.batch")
+
+
+def test_fault_points_count_independently():
+    faults.configure("a=raise:RuntimeError:ka; b@2=raise:RuntimeError:kb")
+    faults.fault_point("b")  # hit 1 of 2: silent
+    with pytest.raises(RuntimeError, match="ka"):
+        faults.fault_point("a")
+    with pytest.raises(RuntimeError, match="kb"):
+        faults.fault_point("b")
+
+
+def test_fault_unknown_exception_name_degrades_to_runtime_error():
+    faults.configure("p=raise:NoSuchError:x")
+    with pytest.raises(RuntimeError):
+        faults.fault_point("p")
+
+
+def test_fault_sigterm_delivers_real_signal():
+    """The sigterm action goes through os.kill, i.e. the handler under
+    test is reached by the same delivery path as an external kill."""
+    hits = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        faults.configure("step.7=sigterm")
+        faults.fault_point("step.7")
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    assert hits == [signal.SIGTERM]
+
+
+def test_fault_describe_lists_unfired():
+    faults.configure("a=raise; b=sigterm")
+    assert sorted(faults.describe()) == ["a@1=raise", "b@1=sigterm"]
+    with pytest.raises(RuntimeError):
+        faults.fault_point("a")
+    assert faults.describe() == ["b@1=sigterm"]
+
+
+# -- retry policy -------------------------------------------------------------
+
+
+def test_bench_markers_are_the_shared_markers():
+    """The satellite contract: bench and scoring share ONE transient
+    classification."""
+    from memvul_tpu.bench import _RETRYABLE_MARKERS
+
+    assert _RETRYABLE_MARKERS is RETRYABLE_MARKERS
+
+
+def test_retry_policy_transient_classification():
+    p = RetryPolicy()
+    assert p.is_transient("jaxlib...: UNAVAILABLE: tunnel dropped")
+    assert p.is_transient("watchdog: phase 'timed_pass' exceeded 600s")
+    assert not p.is_transient("ValueError: genuine bug")
+    assert exception_text(ValueError("x")) == "ValueError: x"
+
+
+def test_retry_policy_retries_transient_then_succeeds():
+    sleeps = []
+    p = RetryPolicy(attempts=3, backoff=5.0, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("UNAVAILABLE: still warming up")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [5.0, 10.0]  # the bench supervisor's linear schedule
+
+
+def test_retry_policy_fails_fast_on_non_transient():
+    sleeps = []
+    p = RetryPolicy(attempts=3, backoff=1.0, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("genuine bug")
+
+    with pytest.raises(ValueError):
+        p.call(bug)
+    assert calls["n"] == 1  # no retries burned
+    assert sleeps == []
+
+
+def test_retry_policy_exhausts_and_raises_last():
+    p = RetryPolicy(attempts=2, backoff=0.0, sleep=lambda s: None)
+
+    def always():
+        raise RuntimeError("DEADLINE_EXCEEDED: nope")
+
+    with pytest.raises(RuntimeError, match="DEADLINE_EXCEEDED"):
+        p.call(always)
+
+
+# -- atomic writes ------------------------------------------------------------
+
+
+def test_atomic_write_roundtrip(tmp_path):
+    p = tmp_path / "meta.json"
+    atomic_write_text(p, '{"a": 1}')
+    assert json.loads(p.read_text()) == {"a": 1}
+    atomic_write_text(p, '{"a": 2}')
+    assert json.loads(p.read_text()) == {"a": 2}
+    assert list(tmp_path.glob("*.tmp.*")) == []
+
+
+def test_atomic_write_torn_window_preserves_previous(tmp_path):
+    """A failure between the tmp write and the rename (the ckpt.write
+    fault point) must leave the previous content byte-identical — the
+    torn-write hazard the bare write_text had."""
+    p = tmp_path / "meta.json"
+    atomic_write_text(p, "GOOD OLD CONTENT")
+    faults.configure("ckpt.write=raise:OSError:disk exploded")
+    with pytest.raises(OSError):
+        atomic_write_text(p, "half-written garbage")
+    assert p.read_text() == "GOOD OLD CONTENT"
+    assert list(tmp_path.glob("*.tmp.*")) == []  # cleans its own litter
+
+
+# -- journal ------------------------------------------------------------------
+
+
+def test_span_compression_roundtrip():
+    idx = [0, 1, 2, 5, 7, 8, 9]
+    spans = to_spans(idx)
+    assert spans == [[0, 3], [5, 6], [7, 10]]
+    assert from_spans(spans) == set(idx)
+    assert to_spans([]) == []
+
+
+def _write_out_and_journal(tmp_path, batches):
+    """Simulate the writer thread: out line + journal entry per batch."""
+    out = tmp_path / "result.json"
+    journal = ScoreJournal(tmp_path / "result.json.journal")
+    with open(out, "w") as f:
+        for i, rows in enumerate(batches):
+            text = json.dumps([{"Issue_Url": f"u{r}", "label": "neg",
+                               "predict": {"a": 0.5}} for r in rows])
+            f.write(text + "\n")
+            f.flush()
+            journal.append(i, rows, text)
+    journal.close()
+    return out, journal
+
+
+def test_journal_verified_prefix_happy_path(tmp_path):
+    out, _ = _write_out_and_journal(tmp_path, [[0, 1], [2, 3], [4]])
+    j = ScoreJournal(tmp_path / "result.json.journal")
+    n, completed, lines = j.verified_prefix(out)
+    assert n == 3
+    assert completed == {0, 1, 2, 3, 4}
+    assert len(lines) == 3
+
+
+def test_journal_detects_torn_output_line(tmp_path):
+    """Killed mid-write: the final output line is truncated.  The
+    verified prefix must stop before it so its rows are re-scored."""
+    out, _ = _write_out_and_journal(tmp_path, [[0, 1], [2, 3]])
+    raw = out.read_bytes()
+    out.write_bytes(raw[:-10])  # tear the final line
+    j = ScoreJournal(tmp_path / "result.json.journal")
+    n, completed, _ = j.verified_prefix(out)
+    assert n == 1
+    assert completed == {0, 1}
+    j.truncate_to(n, out)
+    assert len(out.read_text().splitlines()) == 1
+    assert len(j.read_entries()) == 1
+
+
+def test_journal_torn_final_entry_dropped(tmp_path):
+    """Killed mid-journal-append: the torn last journal line is ignored,
+    the lines before it stay trusted."""
+    out, _ = _write_out_and_journal(tmp_path, [[0, 1], [2, 3]])
+    jpath = tmp_path / "result.json.journal"
+    jpath.write_text(jpath.read_text()[:-15])  # tear the last entry
+    j = ScoreJournal(jpath)
+    n, completed, _ = j.verified_prefix(out)
+    assert n == 1 and completed == {0, 1}
+
+
+def test_journal_missing_or_empty_is_fresh_start(tmp_path):
+    j = ScoreJournal(tmp_path / "nope.journal")
+    assert j.verified_prefix(tmp_path / "nope.json") == (0, set(), [])
+
+
+def test_journal_line_digest_matches_written_text():
+    text = json.dumps([{"predict": {"a": 0.123456}}])
+    assert line_digest(text) == line_digest(text)
+    assert line_digest(text) != line_digest(text + " ")
+
+
+def test_dead_letter_records_reasons(tmp_path):
+    dl = DeadLetter(tmp_path / "dead.jsonl", max_text_chars=10)
+    dl.record("json parse error: bad line", raw="{oops")
+    dl.record("over-long text (99 chars > 10 cap)", meta={"Issue_Url": "u1"})
+    dl.close()
+    entries = [json.loads(l) for l in (tmp_path / "dead.jsonl").read_text().splitlines()]
+    assert dl.count == 2 and len(entries) == 2
+    assert "parse error" in entries[0]["reason"]
+    assert entries[0]["raw"] == "{oops"
+    assert entries[1]["meta"]["Issue_Url"] == "u1"
+
+
+def test_dead_letter_truncates_huge_raw(tmp_path):
+    dl = DeadLetter(tmp_path / "dead.jsonl")
+    dl.record("bad", raw="x" * 100_000)
+    dl.close()
+    entry = json.loads((tmp_path / "dead.jsonl").read_text())
+    assert len(entry["raw"]) == 2000
