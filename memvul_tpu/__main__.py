@@ -10,6 +10,7 @@ scripts (reference: README.md:130-147).  Here everything is one CLI:
     python -m memvul_tpu baseline data/train_project.json data/test_project.json -o baseline_out/
     python -m memvul_tpu build-data --csv all_samples.csv --out data/
     python -m memvul_tpu bench
+    python -m memvul_tpu telemetry-report out/
 
 ``--mesh data=8`` shards any train/evaluate run over a device mesh.
 """
@@ -292,6 +293,20 @@ def cmd_bench(args) -> int:
     return int(bench_main() or 0)
 
 
+def cmd_telemetry_report(args) -> int:
+    """Render a run dir's telemetry sinks (events.jsonl / telemetry.json
+    / HEARTBEAT.json) into a human summary: phase table, step-time
+    percentiles, counter totals, last-heartbeat age."""
+    from .telemetry.report import render_report
+
+    run_dir = Path(args.run_dir)
+    if not run_dir.is_dir():
+        print(f"telemetry-report: {run_dir} is not a directory", file=sys.stderr)
+        return 2
+    print(render_report(run_dir))
+    return 0
+
+
 def cmd_doctor(args) -> int:
     """Environment/artifact self-diagnosis (utils/doctor.py)."""
     from .utils.doctor import run_doctor
@@ -442,6 +457,15 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bench", help="run the throughput benchmark")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "telemetry-report",
+        help="render a run dir's telemetry (events.jsonl / telemetry.json "
+        "/ HEARTBEAT.json) into a human summary: phases, step-time "
+        "percentiles, counters, last-heartbeat age",
+    )
+    p.add_argument("run_dir", help="serialization/output dir of a run")
+    p.set_defaults(fn=cmd_telemetry_report)
 
     p = sub.add_parser(
         "doctor",

@@ -41,8 +41,12 @@ fused Pallas kernel vs decomposed einsum, instead of the full scoring
 pass; BENCH_MICRO_{B,A,D,ITERS} set its shape),
 BENCH_PHASE_TIMEOUT (per-phase watchdog deadline inside the child,
 default 600 s, 0 disables — a stuck phase emits a parseable JSON
-failure record naming the phase and exits 124 fast instead of sitting
-silent until the external ``timeout`` kill; the supervisor retries it).
+failure record naming the phase, its last-heartbeat age (stuck phase vs
+slow backend, cf. BENCH_r05) and exits 124 fast instead of sitting
+silent until the external ``timeout`` kill; the supervisor retries it),
+BENCH_TELEMETRY_DIR (write a telemetry run dir — phase spans in
+events.jsonl, HEARTBEAT.json liveness, telemetry.json rollup — readable
+via ``python -m memvul_tpu telemetry-report``; docs/observability.md).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
@@ -115,18 +119,32 @@ class _PhaseWatchdog:
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        # every phase is a telemetry span: the liveness phase + progress
+        # clock update even without a run dir, and with BENCH_TELEMETRY_DIR
+        # set the spans land in events.jsonl for telemetry-report
+        from memvul_tpu.telemetry import get_registry
+
         if self.timeout <= 0:  # BENCH_PHASE_TIMEOUT=0 disables
-            yield
+            with get_registry().span(f"bench.{name}"):
+                yield
             return
         timer = threading.Timer(self.timeout, self._expire, args=(name,))
         timer.daemon = True
         timer.start()
         try:
-            yield
+            with get_registry().span(f"bench.{name}"):
+                yield
         finally:
             timer.cancel()
 
     def _expire(self, name: str) -> None:
+        from memvul_tpu.telemetry import get_registry
+
+        # last-heartbeat age separates "stuck phase" (age ≈ the whole
+        # phase timeout: nothing progressed since the phase opened) from
+        # "slow backend" (small age: batches were still completing when
+        # the deadline hit) — the rc=124 ambiguity of BENCH_r05
+        age = get_registry().heartbeat_age_s()
         record = {
             "metric": self.metric,
             "value": 0.0,
@@ -135,6 +153,7 @@ class _PhaseWatchdog:
             "error": f"watchdog: phase {name!r} exceeded {self.timeout:.0f}s",
             "phase": name,
             "watchdog_timeout": True,
+            "heartbeat_age_s": round(age, 1),
         }
         sys.stdout.write(json.dumps(record) + "\n")
         sys.stdout.flush()
@@ -633,7 +652,22 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
 
 def main() -> int:
     if os.environ.get(_CHILD_ENV_FLAG) == "1":
-        _run_bench()
+        # BENCH_TELEMETRY_DIR=<dir>: the child keeps a full telemetry run
+        # dir (events.jsonl phase spans, HEARTBEAT.json, telemetry.json)
+        # readable via `python -m memvul_tpu telemetry-report <dir>` —
+        # the registry works in-memory (watchdog heartbeat age) either way
+        tel_dir = os.environ.get("BENCH_TELEMETRY_DIR")
+        if tel_dir:
+            from memvul_tpu.telemetry import configure as _tel_configure
+
+            _tel_configure(run_dir=tel_dir, heartbeat_every_s=10.0)
+        try:
+            _run_bench()
+        finally:
+            if tel_dir:
+                from memvul_tpu.telemetry import get_registry
+
+                get_registry().close()
         return 0
 
     attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "3")))

@@ -205,12 +205,29 @@ def train_from_config(
     mesh=None,
 ) -> Dict[str, Any]:
     """Run a full training job described by a reference-shaped config and
-    archive the best model as ``<dir>/model.tar.gz``."""
+    archive the best model as ``<dir>/model.tar.gz``.
+
+    The config's ``telemetry`` section (config.TELEMETRY_DEFAULTS)
+    configures the process-wide registry with the serialization dir as
+    the run dir, so the trainer's step events / HEARTBEAT.json /
+    telemetry.json land beside the checkpoints; ``telemetry.trace_dir``
+    routes into the trainer's epoch-0 ``jax.profiler`` trace."""
+    from . import telemetry
     from .archive import ARCHIVE_NAME, save_archive
+    from .config import telemetry_config
 
     serialization_dir = Path(serialization_dir)
     serialization_dir.mkdir(parents=True, exist_ok=True)
     (serialization_dir / "config.json").write_text(json.dumps(config, indent=2))
+
+    tel_cfg = telemetry_config(config)
+    tel = telemetry.configure(
+        run_dir=serialization_dir,
+        enabled=bool(tel_cfg["enabled"]),
+        events=bool(tel_cfg["events"]),
+        heartbeat_every_s=float(tel_cfg["heartbeat_every_s"]),
+        step_events=bool(tel_cfg["step_events"]),
+    )
 
     seed = int(config.get("random_seed", 2021))
     tokenizer = build_tokenizer(config.get("tokenizer"))
@@ -231,6 +248,10 @@ def train_from_config(
     trainer_cfg = dict(config.get("trainer") or {})
     trainer_cfg.setdefault("seed", seed)
     trainer_cfg["serialization_dir"] = str(serialization_dir)
+    if tel_cfg["trace_dir"] and not trainer_cfg.get("profile_dir"):
+        # the telemetry.trace_dir knob rides the trainer's existing
+        # epoch-0 trace_context; named scopes make the trace legible
+        trainer_cfg["profile_dir"] = str(tel_cfg["trace_dir"])
     model_type = model_cfg.get("type", "model_memory")
 
     if model_type == "model_memory":
@@ -262,19 +283,25 @@ def train_from_config(
             mesh=mesh,
         )
 
-    result = trainer.train()
-    best = jax.device_get(trainer.best_params())
-    archived = dict(config)
-    archived["model"] = dict(model_cfg)
-    save_archive(
-        serialization_dir / ARCHIVE_NAME,
-        archived,
-        best,
-        tokenizer_file=_tokenizer_file(config.get("tokenizer")),
-    )
-    (serialization_dir / "metrics.json").write_text(
-        json.dumps(result, indent=2, default=float)
-    )
+    try:
+        result = trainer.train()
+        best = jax.device_get(trainer.best_params())
+        archived = dict(config)
+        archived["model"] = dict(model_cfg)
+        with tel.span("archive"):
+            save_archive(
+                serialization_dir / ARCHIVE_NAME,
+                archived,
+                best,
+                tokenizer_file=_tokenizer_file(config.get("tokenizer")),
+            )
+        (serialization_dir / "metrics.json").write_text(
+            json.dumps(result, indent=2, default=float)
+        )
+    finally:
+        # final heartbeat + telemetry.json rollup, even on a crash — the
+        # post-mortem is exactly when the summary matters
+        tel.close()
     result["archive"] = str(serialization_dir / ARCHIVE_NAME)
     return result
 
@@ -314,12 +341,22 @@ def evaluate_from_archive(
     """The reference's eval flow: load archive with overrides, score the
     test corpus, write ``{name}_result.json`` + ``{name}_metric_all.json``
     (reference: predict_memory.py:49-114,159-197)."""
+    from . import telemetry
     from .archive import load_archive
-    from .config import evaluation_config
+    from .config import evaluation_config, telemetry_config
+    from .utils.profiling import trace_context
 
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     arch = load_archive(archive_path, overrides=overrides)
+    tel_cfg = telemetry_config(arch.config)
+    tel = telemetry.configure(
+        run_dir=out_dir,
+        enabled=bool(tel_cfg["enabled"]),
+        events=bool(tel_cfg["events"]),
+        heartbeat_every_s=float(tel_cfg["heartbeat_every_s"]),
+        step_events=bool(tel_cfg["step_events"]),
+    )
     model_cfg = arch.config.get("model") or {}
     model_type = model_cfg.get("type", "model_memory")
     name = name or model_type
@@ -366,53 +403,62 @@ def evaluate_from_archive(
 
     out_results = out_dir / f"{name}_result.json"
     out_metrics = out_dir / f"{name}_metric_all.json"
-    if model_type == "model_memory":
-        from .evaluate.predict_memory import test_siamese
+    # telemetry.trace_dir wraps the WHOLE scoring pass in a jax.profiler
+    # trace (the named scopes in models/ops make it attributable); the
+    # registry rolls up to <out_dir>/telemetry.json on the way out
+    try:
+        with trace_context(tel_cfg["trace_dir"]):
+            if model_type == "model_memory":
+                from .evaluate.predict_memory import test_siamese
 
-        golden = golden_file or (arch.config.get("dataset_reader") or {}).get(
-            "anchor_path"
-        )
-        if golden is None:
-            raise ValueError("memory-model evaluation needs a golden anchor file")
-        return test_siamese(
-            arch.model,
-            arch.params,
-            arch.tokenizer,
-            test_file=test_path,
-            golden_file=golden,
-            out_results=out_results,
-            out_metrics=out_metrics,
-            reader=reader,
-            mesh=mesh,
-            use_mesh=use_mesh,
-            batch_size=batch_size,
-            max_length=max_length,
-            buckets=buckets,
-            tokens_per_batch=tokens_per_batch,
-            thres=thres,
-            inflight=inflight,
-            anchor_match_impl=eval_cfg["anchor_match_impl"],
-            aot_warmup=bool(eval_cfg["aot_warmup"]),
-            resume=bool(eval_cfg["resume"]),
-            quarantine=eval_cfg["quarantine"],
-            heartbeat_batches=int(eval_cfg["heartbeat_batches"]),
-            score_retries=int(eval_cfg["score_retries"]),
-        )
-    from .evaluate.predict_single import test_single
+                golden = golden_file or (
+                    arch.config.get("dataset_reader") or {}
+                ).get("anchor_path")
+                if golden is None:
+                    raise ValueError(
+                        "memory-model evaluation needs a golden anchor file"
+                    )
+                return test_siamese(
+                    arch.model,
+                    arch.params,
+                    arch.tokenizer,
+                    test_file=test_path,
+                    golden_file=golden,
+                    out_results=out_results,
+                    out_metrics=out_metrics,
+                    reader=reader,
+                    mesh=mesh,
+                    use_mesh=use_mesh,
+                    batch_size=batch_size,
+                    max_length=max_length,
+                    buckets=buckets,
+                    tokens_per_batch=tokens_per_batch,
+                    thres=thres,
+                    inflight=inflight,
+                    anchor_match_impl=eval_cfg["anchor_match_impl"],
+                    aot_warmup=bool(eval_cfg["aot_warmup"]),
+                    resume=bool(eval_cfg["resume"]),
+                    quarantine=eval_cfg["quarantine"],
+                    heartbeat_batches=int(eval_cfg["heartbeat_batches"]),
+                    score_retries=int(eval_cfg["score_retries"]),
+                )
+            from .evaluate.predict_single import test_single
 
-    return test_single(
-        arch.model,
-        arch.params,
-        arch.tokenizer,
-        test_file=test_path,
-        out_results=out_results,
-        out_metrics=out_metrics,
-        reader=reader,
-        mesh=mesh,
-        use_mesh=use_mesh,
-        batch_size=batch_size,
-        max_length=max_length,
-        buckets=buckets,
-        tokens_per_batch=tokens_per_batch,
-        inflight=inflight,
-    )
+            return test_single(
+                arch.model,
+                arch.params,
+                arch.tokenizer,
+                test_file=test_path,
+                out_results=out_results,
+                out_metrics=out_metrics,
+                reader=reader,
+                mesh=mesh,
+                use_mesh=use_mesh,
+                batch_size=batch_size,
+                max_length=max_length,
+                buckets=buckets,
+                tokens_per_batch=tokens_per_batch,
+                inflight=inflight,
+            )
+    finally:
+        tel.close()

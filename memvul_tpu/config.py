@@ -242,24 +242,51 @@ EVALUATION_DEFAULTS: Dict[str, Any] = {
 }
 
 
-def evaluation_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
-    """``cfg["evaluation"]`` merged over :data:`EVALUATION_DEFAULTS`.
+def _section_over_defaults(
+    cfg: Optional[Dict[str, Any]], key: str, defaults: Dict[str, Any]
+) -> Dict[str, Any]:
+    """``cfg[key]`` merged over its documented defaults.
 
     Explicit JSON ``null`` values fall back to the default (matching the
-    existing null-tolerant handling of ``tokens_per_batch``/``inflight``;
-    0 and "" are real values and survive).  Unknown keys are kept — they
-    may belong to a newer reader — but logged so a typo like
-    ``"ancor_match_impl"`` doesn't silently disable a feature.
+    historical null-tolerant handling of ``tokens_per_batch``/
+    ``inflight``; 0 and "" are real values and survive).  Unknown keys
+    are kept — they may belong to a newer reader — but logged so a typo
+    like ``"ancor_match_impl"`` doesn't silently disable a feature.
     """
     import logging
 
-    section = dict((cfg or {}).get("evaluation") or {})
-    unknown = sorted(set(section) - set(EVALUATION_DEFAULTS))
+    section = dict((cfg or {}).get(key) or {})
+    unknown = sorted(set(section) - set(defaults))
     if unknown:
         logging.getLogger(__name__).warning(
-            "evaluation config: unknown key(s) %s (known: %s)",
-            unknown, sorted(EVALUATION_DEFAULTS),
+            "%s config: unknown key(s) %s (known: %s)",
+            key, unknown, sorted(defaults),
         )
-    out = dict(EVALUATION_DEFAULTS)
+    out = dict(defaults)
     out.update({k: v for k, v in section.items() if v is not None})
     return out
+
+
+def evaluation_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``cfg["evaluation"]`` merged over :data:`EVALUATION_DEFAULTS`."""
+    return _section_over_defaults(cfg, "evaluation", EVALUATION_DEFAULTS)
+
+
+# The ``telemetry`` config section (docs/observability.md).  Read by the
+# build entry points, which configure the process-wide registry
+# (memvul_tpu.telemetry) with the run's serialization/output dir before
+# the trainers/predictors start reporting through it.
+TELEMETRY_DEFAULTS: Dict[str, Any] = {
+    "enabled": True,         # registry + sinks in the run dir
+    "events": True,          # append-only events.jsonl stream
+    "step_events": True,     # per-step train_step events (drain cadence)
+    "heartbeat_every_s": 30.0,  # HEARTBEAT.json max write rate
+    # jax.profiler trace dir for the run's hot section (the named-scope
+    # map in docs/observability.md tells xprof time apart); None = off
+    "trace_dir": None,
+}
+
+
+def telemetry_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``cfg["telemetry"]`` merged over :data:`TELEMETRY_DEFAULTS`."""
+    return _section_over_defaults(cfg, "telemetry", TELEMETRY_DEFAULTS)

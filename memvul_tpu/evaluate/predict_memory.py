@@ -13,6 +13,7 @@ chips with host-side tokenization prefetched off the critical path.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import time
@@ -38,6 +39,7 @@ from ..parallel.mesh import MODEL_AXIS, create_mesh, replicate, shard_batch
 from ..resilience import faults
 from ..resilience.journal import DeadLetter, ScoreJournal
 from ..resilience.retry import RetryPolicy, exception_text
+from ..telemetry import get_registry
 from ..training.metrics import SiameseMeasure
 from .measure import cal_metrics
 
@@ -132,6 +134,7 @@ class SiamesePredictor:
             "(identical scores; see docs/anchor_match_kernel.md)",
             f"{type(error).__name__}: {error}",
         )
+        get_registry().counter("score.degradations").inc()
         self.anchor_match_impl = "xla"
         self._build_score_fn()
         return True
@@ -141,6 +144,10 @@ class SiamesePredictor:
     def encode_anchors(self, anchor_instances: Iterable[Dict]) -> None:
         """Encode anchors in fixed-size chunks (reference encodes ≤128 at a
         time, predict_memory.py:81-83) and cache the bank on device."""
+        with get_registry().span("anchor_encode"):
+            self._encode_anchors(anchor_instances)
+
+    def _encode_anchors(self, anchor_instances: Iterable[Dict]) -> None:
         instances = list(anchor_instances)
         self.anchor_labels = [inst["meta"]["label"] for inst in instances]
         chunks: List[np.ndarray] = []
@@ -221,22 +228,27 @@ class SiamesePredictor:
             raise RuntimeError("call encode_anchors() first")
         shapes = self.stream_shapes()
         start = time.perf_counter()
-        for rows, length in shapes:
-            sample = {
-                "input_ids": np.zeros((rows, length), np.int32),
-                "attention_mask": np.ones((rows, length), np.int32),
-            }
-            if self.mesh is not None:
-                sample = shard_batch(sample, self.mesh)
-            try:
-                self._score_fn.lower(self.params, sample, self.anchor_bank).compile()
-            except Exception as e:
-                if not self._maybe_degrade_to_xla(e):
-                    raise
-                # the rebuilt program invalidates any shapes already
-                # compiled on the fused one — restart the warmup so the
-                # zero-mid-stream-compile contract still holds
-                return self.warmup_compile()
+        tel = get_registry()
+        with tel.span("aot_warmup", shapes=len(shapes)):
+            for rows, length in shapes:
+                tel.progress()  # each compile is progress, not a stall
+                sample = {
+                    "input_ids": np.zeros((rows, length), np.int32),
+                    "attention_mask": np.ones((rows, length), np.int32),
+                }
+                if self.mesh is not None:
+                    sample = shard_batch(sample, self.mesh)
+                try:
+                    self._score_fn.lower(
+                        self.params, sample, self.anchor_bank
+                    ).compile()
+                except Exception as e:
+                    if not self._maybe_degrade_to_xla(e):
+                        raise
+                    # the rebuilt program invalidates any shapes already
+                    # compiled on the fused one — restart the warmup so
+                    # the zero-mid-stream-compile contract still holds
+                    return self.warmup_compile()
         logger.info(
             "AOT warmup: %d score program(s) %s compiled in %.1fs",
             len(shapes), shapes, time.perf_counter() - start,
@@ -305,6 +317,12 @@ class SiamesePredictor:
                     return once()  # re-dispatch through the rebuilt program
                 raise
 
+        tel = get_registry()
+        latency_hist = tel.histogram("score.batch_latency_s")
+        occupancy_hist = tel.histogram("score.bucket_occupancy")
+        batches_ctr = tel.counter("score.batches")
+        rows_ctr = tel.counter("score.rows")
+        last_sync = time.perf_counter()
         for dev, batch in inflight_pipeline(
             prefetch(batches, depth=prefetch_depth), dispatch, inflight=inflight
         ):
@@ -322,7 +340,19 @@ class SiamesePredictor:
                     "batch failed at host sync (%s) — re-dispatching",
                     exception_text(e)[:200],
                 )
+                get_registry().counter("resilience.retries").inc()
                 arr = np.asarray(dispatch(batch))
+            # batch telemetry: host-sync-to-host-sync latency (the
+            # steady-state inverse throughput under the inflight
+            # pipeline), real-row occupancy of the padded batch shape,
+            # and a liveness tick the watchdogs age against
+            now = time.perf_counter()
+            latency_hist.observe(now - last_sync)
+            last_sync = now
+            occupancy_hist.observe(len(metas) / max(1, arr.shape[0]))
+            batches_ctr.inc()
+            rows_ctr.inc(len(metas))
+            tel.progress()
             # drop dead rows and any zero-padded anchor columns
             yield arr[: len(metas), : self.n_anchors], metas
 
@@ -337,6 +367,7 @@ class SiamesePredictor:
         quarantine: Union[bool, str, Path, None] = None,
         heartbeat_batches: int = 0,
         retry_policy: Optional[RetryPolicy] = None,
+        expected_reports: Optional[int] = None,
     ) -> Dict[str, float]:
         """Stream a corpus file, write the reference-format result lines,
         return the threshold-swept siamese metrics.
@@ -358,8 +389,11 @@ class SiamesePredictor:
           dead-letters malformed/over-long records with reasons instead
           of killing the stream.
         * ``heartbeat_batches=N`` logs progress every N batches —
-          reports/s, batches this run vs journal total, quarantine count
-          — so a stalled corpus run is distinguishable from a slow one.
+          rows/s, ETA (when ``expected_reports`` is known — the corpus
+          streams, so the total is a caller-supplied hint), batches this
+          run vs journal total, quarantine count — and writes the run
+          dir's ``HEARTBEAT.json`` through the telemetry registry, so a
+          stalled corpus run is distinguishable from a slow one.
         * ``retry_policy`` retries transiently-failing batches
           (see :meth:`score_instances`).
         """
@@ -409,6 +443,9 @@ class SiamesePredictor:
         writer_error: List[BaseException] = []
         failed = threading.Event()
 
+        tel = get_registry()
+        commit_lag_hist = tel.histogram("score.journal_commit_lag_s")
+
         def _writer() -> None:
             try:
                 with open(out_path, "a" if resume else "w") as f:
@@ -416,7 +453,7 @@ class SiamesePredictor:
                         item = q.get()
                         if item is None:
                             return
-                        probs, metas = item
+                        probs, metas, enqueued_monotonic = item
                         records = [
                             {
                                 "Issue_Url": meta.get("Issue_Url"),
@@ -439,6 +476,13 @@ class SiamesePredictor:
                                 [meta["_row"] for meta in metas],
                                 text,
                             )
+                            # commit lag: scored-on-host → durable-in-
+                            # journal.  A growing lag means the writer
+                            # thread (serialization + fsync-side cost)
+                            # is falling behind the device
+                            commit_lag_hist.observe(
+                                time.monotonic() - enqueued_monotonic
+                            )
             except BaseException as e:  # propagated to the caller below
                 writer_error.append(e)
                 failed.set()
@@ -451,13 +495,22 @@ class SiamesePredictor:
         writer = threading.Thread(target=_writer, daemon=True)
         writer.start()
         batches_done = 0
+        # rows/sec is sourced from the registry's score.rows counter
+        # (delta over this call — the counter is process-cumulative);
+        # with telemetry disabled the null counter stays 0 and the local
+        # count is the fallback, same number by construction
+        rows_ctr_start = tel.counter("score.rows").value
+        # an explicit ExitStack (not a nested with) keeps the span's exit
+        # inside the finally block without re-indenting the hot loop
+        span = contextlib.ExitStack()
+        span.enter_context(tel.span("score_stream"))
         try:
             for probs, metas in self.score_instances(
                 instances, inflight=inflight, retry_policy=retry_policy
             ):
                 while not failed.is_set():
                     try:
-                        q.put((probs, metas), timeout=1.0)
+                        q.put((probs, metas, time.monotonic()), timeout=1.0)
                         break
                     except queue.Full:
                         continue
@@ -468,15 +521,27 @@ class SiamesePredictor:
                 batches_done += 1
                 if heartbeat_batches and batches_done % heartbeat_batches == 0:
                     elapsed = time.perf_counter() - start
+                    rows_delta = tel.counter("score.rows").value - rows_ctr_start
+                    rows_this_run = rows_delta or (n - n_resumed)
+                    rate = rows_this_run / max(elapsed, 1e-9)
+                    eta_s = None
+                    if expected_reports and rate > 0:
+                        eta_s = max(0.0, (expected_reports - n) / rate)
                     logger.info(
                         "scoring heartbeat: %d batches this run (journal "
-                        "total %s), %d/%d reports, %.0f reports/s, %d "
-                        "quarantined",
+                        "total %s), %d/%d reports, %.0f rows/s, ETA %s, "
+                        "%d quarantined",
                         batches_done,
                         journal.entries_written if journal is not None else "-",
-                        n - n_resumed, n,
-                        (n - n_resumed) / max(elapsed, 1e-9),
+                        rows_this_run, n, rate,
+                        f"{eta_s:.0f}s" if eta_s is not None else "unknown",
                         dead.count if dead is not None else 0,
+                    )
+                    tel.heartbeat(
+                        force=True,
+                        rows_scored=n,
+                        rows_per_sec=round(rate, 1),
+                        eta_s=round(eta_s, 1) if eta_s is not None else None,
                     )
         finally:
             # signal end-of-stream with the same failure-aware loop as the
@@ -499,6 +564,11 @@ class SiamesePredictor:
                 journal.close()
             if dead is not None:
                 dead.close()
+            span.close()
+            # final liveness snapshot AFTER the writer drained: its
+            # counters (journal.rows_committed et al.) now match what is
+            # durably on disk — the invariant the chaos test pins
+            tel.heartbeat(force=True, rows_scored=n)
         if writer_error:
             raise writer_error[0]
         elapsed = time.perf_counter() - start
@@ -555,14 +625,16 @@ def test_siamese(
     quarantine: Union[bool, str, Path, None] = None,
     heartbeat_batches: int = 0,
     score_retries: int = 0,
+    expected_reports: Optional[int] = None,
 ) -> Dict[str, float]:
     """End-to-end evaluation mirroring the reference's ``test_siamese``
     (predict_memory.py:49-114) + ``cal_metrics`` (:159-197).
 
-    ``resume``/``quarantine``/``heartbeat_batches`` are forwarded to
-    :meth:`SiamesePredictor.predict_file`; ``score_retries`` > 0 builds
-    the shared transient-failure :class:`RetryPolicy` with that attempt
-    budget (docs/fault_tolerance.md)."""
+    ``resume``/``quarantine``/``heartbeat_batches``/``expected_reports``
+    are forwarded to :meth:`SiamesePredictor.predict_file`;
+    ``score_retries`` > 0 builds the shared transient-failure
+    :class:`RetryPolicy` with that attempt budget
+    (docs/fault_tolerance.md)."""
     reader = reader or MemoryReader()
     if mesh is None and use_mesh and len(jax.devices()) > 1:
         mesh = create_mesh()
@@ -586,6 +658,7 @@ def test_siamese(
         heartbeat_batches=heartbeat_batches,
         retry_policy=RetryPolicy(attempts=score_retries)
         if score_retries > 0 else None,
+        expected_reports=expected_reports,
     )
     final = cal_metrics(out_results, thres=thres, out_file=out_metrics)
     final.update({f"s_{k}": v for k, v in eval_metrics.items()})

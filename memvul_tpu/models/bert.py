@@ -293,14 +293,18 @@ class BertEncoder(nn.Module):
             )
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        hidden = BertEmbeddings(c, name="embeddings")(
-            input_ids, token_type_ids, deterministic, position_ids=position_ids
-        )
-        bias = mask_to_bias(attention_mask, dtype=c.dtype)
-        out = BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)
+        # named scopes: profile/jaxpr attribution (docs/observability.md)
+        with jax.named_scope("bert_embeddings"):
+            hidden = BertEmbeddings(c, name="embeddings")(
+                input_ids, token_type_ids, deterministic, position_ids=position_ids
+            )
+            bias = mask_to_bias(attention_mask, dtype=c.dtype)
+        with jax.named_scope("bert_layers"):
+            out = BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)
         if c.last_layer_only:
             return out
-        return ScalarMix(c, name="scalar_mix")(out)
+        with jax.named_scope("scalar_mix"):
+            return ScalarMix(c, name="scalar_mix")(out)
 
 
 class BertPooler(nn.Module):

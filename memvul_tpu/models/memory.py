@@ -70,22 +70,31 @@ class MemoryModel(nn.Module):
         )
 
     def encode(self, sample, deterministic: bool = True) -> jax.Array:
-        """Token batch {input_ids, attention_mask[, token_type_ids]} → [B, D]."""
-        hidden = self.encoder(
-            sample["input_ids"],
-            sample["attention_mask"],
-            sample.get("token_type_ids"),
-            deterministic=deterministic,
-        )
-        pooled = self.pooler(hidden, deterministic=deterministic)
+        """Token batch {input_ids, attention_mask[, token_type_ids]} → [B, D].
+
+        The named scopes here (with the per-op ones inside the encoder)
+        are what make a ``trace_context`` profile attributable — xprof
+        shows "bert_encode"/"pooler"/"header" rows instead of one fused
+        blob (docs/observability.md, named-scope map)."""
+        with jax.named_scope("bert_encode"):
+            hidden = self.encoder(
+                sample["input_ids"],
+                sample["attention_mask"],
+                sample.get("token_type_ids"),
+                deterministic=deterministic,
+            )
+        with jax.named_scope("pooler"):
+            pooled = self.pooler(hidden, deterministic=deterministic)
         if self.use_header:
-            pooled = self.header(pooled, deterministic=deterministic)
+            with jax.named_scope("header"):
+                pooled = self.header(pooled, deterministic=deterministic)
         return pooled
 
     def pair_logits(self, u: jax.Array, v: jax.Array) -> jax.Array:
         """[B, D] × [B, D] → [B, 2] (training path)."""
-        features = jnp.concatenate([u, v, jnp.abs(u - v)], axis=-1)
-        return features @ self.pair_kernel.astype(features.dtype)
+        with jax.named_scope("pair_logits"):
+            features = jnp.concatenate([u, v, jnp.abs(u - v)], axis=-1)
+            return features @ self.pair_kernel.astype(features.dtype)
 
     def match_anchors(
         self, u: jax.Array, anchors: jax.Array, impl: Optional[str] = None

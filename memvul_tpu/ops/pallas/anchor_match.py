@@ -284,12 +284,20 @@ def anchor_match(
         raise ValueError(
             f"unknown anchor_match impl {impl!r} (want auto | fused | xla)"
         )
+    # named scopes tell the two backends apart in profiles/jaxprs — the
+    # kernel work stops being an anonymous blob in xprof
+    # (docs/observability.md, named-scope map)
     if use_fused:
         from ...resilience import faults
 
         try:
             faults.fault_point("kernel.lower")
-            return fused_anchor_match(u, anchors, kernel, interpret=interpret)
+            with jax.named_scope("anchor_match_fused"):
+                return fused_anchor_match(u, anchors, kernel, interpret=interpret)
         except Exception as e:
+            from ...telemetry import get_registry
+
+            get_registry().counter("kernel.degradations").inc()
             _warn_fused_fallback(e)
-    return anchor_match_reference(u, anchors, kernel)
+    with jax.named_scope("anchor_match_xla"):
+        return anchor_match_reference(u, anchors, kernel)

@@ -37,7 +37,10 @@ def flash_attention_or_fallback(
         from .flash_kernel import UnsupportedBiasError, flash_attention
 
         try:
-            return flash_attention(query, key, value, bias)
+            # named scope: the kernel shows up as "flash_attention" in
+            # trace_context profiles instead of an anonymous custom call
+            with jax.named_scope("flash_attention"):
+                return flash_attention(query, key, value, bias)
         except UnsupportedBiasError:
             # only the documented bias-shape rejection falls back; any
             # other kernel failure propagates so regressions surface

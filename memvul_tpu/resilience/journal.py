@@ -95,6 +95,11 @@ class DeadLetter:
         self._f.flush()
         self.count += 1
         logger.warning("dead-letter: %s", reason)
+        # lazy import: telemetry must stay importable without resilience
+        # (the dependency edge points resilience → telemetry only)
+        from ..telemetry import get_registry
+
+        get_registry().counter("score.dead_letters").inc()
 
     def close(self) -> None:
         if self._f is not None:
@@ -215,6 +220,15 @@ class ScoreJournal:
         self._f.write(json.dumps(entry) + "\n")
         self._f.flush()
         self.entries_written += 1
+        # committed-work counters (this process's appends only — a
+        # resumed prefix was committed by an earlier process); the
+        # HEARTBEAT.json snapshot of these is what lets a supervisor
+        # check liveness against the journal itself
+        from ..telemetry import get_registry
+
+        tel = get_registry()
+        tel.counter("journal.lines_committed").inc()
+        tel.counter("journal.rows_committed").inc(len(rows))
 
     def close(self) -> None:
         if self._f is not None:

@@ -82,6 +82,11 @@ class RetryPolicy:
                 last = e
                 if attempt >= self.attempts:
                     break
+                # lazy import — resilience counts INTO telemetry, never
+                # the other way (see docs/observability.md)
+                from ..telemetry import get_registry
+
+                get_registry().counter("resilience.retries").inc()
                 if on_retry is not None:
                     on_retry(e, attempt)
                 logger.warning(

@@ -1,0 +1,21 @@
+"""Unified run telemetry: counters / gauges / histograms / spans with
+JSONL + summary + liveness sinks (docs/observability.md).
+
+The train, score, and bench paths all report through the process-wide
+registry here; ``python -m memvul_tpu telemetry-report <run_dir>``
+renders what a run left behind.
+"""
+
+from .registry import (  # noqa: F401
+    NULL_COUNTER,
+    NULL_GAUGE,
+    NULL_HISTOGRAM,
+    Counter,
+    Gauge,
+    Histogram,
+    TelemetryRegistry,
+    configure,
+    get_registry,
+    reset,
+)
+from .sinks import read_jsonl  # noqa: F401

@@ -1,0 +1,419 @@
+"""Process-wide run telemetry: counters, gauges, histograms, timed spans.
+
+Every long-running path (train epoch loops, corpus scoring, the bench
+phases) reports through one registry instead of each keeping a private
+log line, so a supervisor — or ``python -m memvul_tpu telemetry-report``
+— sees one coherent picture of a run.  Contract (docs/observability.md):
+
+* **near-zero overhead when disabled** — the accessors hand back shared
+  no-op singletons, so instrumented code keeps unconditional ``.inc()``
+  / ``.observe()`` calls without per-call branching, and the hot loops
+  gate their event emission on ``registry.enabled`` /
+  ``registry.step_events`` so a disabled run performs zero additional
+  per-step host work;
+* **liveness is tracked even when disabled** — :meth:`~TelemetryRegistry
+  .progress` updates two in-memory timestamps (monotonic + wall), which
+  is what lets the bench watchdog report a heartbeat age in its failure
+  record without requiring a run dir;
+* **sinks attach only when a run dir is configured** — an append-only
+  ``events.jsonl`` stream, a rolled-up ``telemetry.json`` summary, and
+  the ``HEARTBEAT.json`` liveness file (see :mod:`.sinks` for the
+  torn-write story of each).
+
+The registry is deliberately dependency-light: no jax, no numpy, and no
+import of ``resilience`` at load time (resilience modules count *into*
+telemetry, so the edge must point one way).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+from .sinks import HeartbeatFile, JsonlSink, SummaryFile
+
+
+class Counter:
+    """Monotonic event count (thread-safe — the scoring writer thread
+    and the main loop both increment)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (e.g. tokens/sec of the latest epoch)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+
+class Histogram:
+    """Streaming count/sum/min/max plus a bounded reservoir sample for
+    percentiles — a 1.2M-batch scoring run must not pin one float per
+    observation in host RAM."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_sample", "_cap", "_rng", "_lock")
+
+    def __init__(self, name: str, cap: int = 4096) -> None:
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._sample: List[float] = []
+        self._cap = cap
+        self._rng = random.Random(0x5EED)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += value
+            if self.min is None or value < self.min:
+                self.min = value
+            if self.max is None or value > self.max:
+                self.max = value
+            if len(self._sample) < self._cap:
+                self._sample.append(value)
+            else:
+                # classic reservoir: keep each observation with p=cap/n
+                j = self._rng.randrange(self.count)
+                if j < self._cap:
+                    self._sample[j] = value
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._sample:
+                return None
+            ordered = sorted(self._sample)
+        idx = int(round((len(ordered) - 1) * (q / 100.0)))
+        return ordered[max(0, min(idx, len(ordered) - 1))]
+
+    def summary(self) -> Dict[str, float]:
+        if not self.count:
+            return {}
+        out = {
+            "count": float(self.count),
+            "total": self.total,
+            "mean": self.total / self.count,
+            "min": self.min,
+            "max": self.max,
+        }
+        for q in (50, 95):
+            p = self.percentile(q)
+            if p is not None:
+                out[f"p{q}"] = p
+        return out
+
+
+class _NullCounter:
+    __slots__ = ()
+    value = 0
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+
+class _NullGauge:
+    __slots__ = ()
+    value = None
+
+    def set(self, value: float) -> None:
+        pass
+
+
+class _NullHistogram:
+    __slots__ = ()
+    count = 0
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def percentile(self, q: float) -> Optional[float]:
+        return None
+
+    def summary(self) -> Dict[str, float]:
+        return {}
+
+
+NULL_COUNTER = _NullCounter()
+NULL_GAUGE = _NullGauge()
+NULL_HISTOGRAM = _NullHistogram()
+
+
+class TelemetryRegistry:
+    """One process-wide bag of named metrics + the liveness clock.
+
+    Use the module-level :func:`get_registry` / :func:`configure` pair;
+    constructing a registry directly is for tests.
+    """
+
+    def __init__(
+        self,
+        run_dir: Optional[Union[str, Path]] = None,
+        enabled: bool = False,
+        events: bool = True,
+        heartbeat_every_s: float = 30.0,
+        step_events: bool = True,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.run_dir = Path(run_dir) if run_dir else None
+        # per-step event emission (train_step lines in events.jsonl);
+        # hot loops read this one attribute as their cadence gate
+        self.step_events = bool(step_events) and self.enabled
+        self.heartbeat_every_s = float(heartbeat_every_s)
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+        self._phase_stack: List[str] = []
+        now_m, now_w = time.monotonic(), time.time()
+        self.started_monotonic = now_m
+        self.started_wall = now_w
+        self.last_progress_monotonic = now_m
+        self.last_progress_wall = now_w
+        self._last_heartbeat_monotonic = float("-inf")
+        self._closed = False
+        self._events: Optional[JsonlSink] = None
+        self._heartbeat_file: Optional[HeartbeatFile] = None
+        self._summary_file: Optional[SummaryFile] = None
+        if self.enabled and self.run_dir is not None:
+            self.run_dir.mkdir(parents=True, exist_ok=True)
+            if events:
+                self._events = JsonlSink(self.run_dir / "events.jsonl")
+            self._heartbeat_file = HeartbeatFile(self.run_dir / "HEARTBEAT.json")
+            self._summary_file = SummaryFile(self.run_dir / "telemetry.json")
+            self.event("run_start", pid=os.getpid())
+
+    # -- metric accessors ------------------------------------------------------
+
+    def counter(self, name: str):
+        if not self.enabled:
+            return NULL_COUNTER
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str):
+        if not self.enabled:
+            return NULL_GAUGE
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str):
+        if not self.enabled:
+            return NULL_HISTOGRAM
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    # -- liveness --------------------------------------------------------------
+
+    @property
+    def phase(self) -> str:
+        return self._phase_stack[-1] if self._phase_stack else "idle"
+
+    def progress(self) -> None:
+        """Mark forward progress.  Always updates the in-memory clocks —
+        even disabled — so a watchdog can compute a heartbeat age; costs
+        two clock reads, called at batch/drain granularity only."""
+        self.last_progress_monotonic = time.monotonic()
+        self.last_progress_wall = time.time()
+
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the last recorded progress."""
+        return time.monotonic() - self.last_progress_monotonic
+
+    def heartbeat(self, force: bool = False, **extra: Any) -> None:
+        """Write ``HEARTBEAT.json`` (rate-limited to ``heartbeat_every_s``
+        unless ``force``).  Callers invoke this exactly at progress
+        milestones, so it also marks progress."""
+        self.progress()
+        if self._heartbeat_file is None or self._closed:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_heartbeat_monotonic < self.heartbeat_every_s:
+            return
+        self._last_heartbeat_monotonic = now
+        payload: Dict[str, Any] = {
+            "phase": self.phase,
+            "pid": os.getpid(),
+            "written_wall": time.time(),
+            "uptime_s": round(now - self.started_monotonic, 3),
+            "last_progress_wall": self.last_progress_wall,
+            "last_progress_monotonic": self.last_progress_monotonic,
+            "counters": self._counter_values(),
+        }
+        payload.update(extra)
+        self._heartbeat_file.write(payload)
+
+    # -- events / spans --------------------------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Append one record to the JSONL event stream (no-op without a
+        configured sink)."""
+        if self._events is None or self._closed:
+            return
+        record: Dict[str, Any] = {
+            "t": round(time.time(), 3),
+            "mono": round(time.monotonic() - self.started_monotonic, 6),
+            "kind": kind,
+            "phase": self.phase,
+        }
+        record.update(fields)
+        self._events.emit(record)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields: Any) -> Iterator[None]:
+        """Timed phase scope: sets the liveness phase for its duration,
+        feeds ``span.<name>`` timing stats, and emits start/end events."""
+        self._phase_stack.append(name)
+        self.progress()
+        self.event("span_start", name=name, **fields)
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - start
+            if self._phase_stack and self._phase_stack[-1] == name:
+                self._phase_stack.pop()
+            self.histogram(f"span.{name}").observe(dur)
+            self.event("span", name=name, dur_s=round(dur, 6), **fields)
+            self.heartbeat()
+
+    def set_phase(self, name: str) -> None:
+        """Replace the phase stack (for flat, non-nested phase reporting)."""
+        self._phase_stack[:] = [name]
+        self.progress()
+        self.event("phase", name=name)
+
+    # -- rollup ----------------------------------------------------------------
+
+    def _counter_values(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: c.value for k, c in sorted(self._counters.items())}
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = {k: c.value for k, c in sorted(self._counters.items())}
+            gauges = {
+                k: g.value for k, g in sorted(self._gauges.items())
+                if g.value is not None
+            }
+            hists = list(sorted(self._histograms.items()))
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {k: h.summary() for k, h in hists},
+        }
+
+    def write_summary(self, **extra: Any) -> None:
+        """Roll the current state up into ``telemetry.json``."""
+        if self._summary_file is None:
+            return
+        payload: Dict[str, Any] = {
+            "run_dir": str(self.run_dir),
+            "phase": self.phase,
+            "started_wall": self.started_wall,
+            "written_wall": time.time(),
+            "uptime_s": round(time.monotonic() - self.started_monotonic, 3),
+        }
+        payload.update(self.snapshot())
+        payload.update(extra)
+        self._summary_file.write(payload)
+
+    def close(self) -> None:
+        """Final rollup: ``run_end`` event, forced heartbeat, summary.
+        Idempotent; the registry goes quiet (accessors return the no-op
+        singletons) afterwards."""
+        if self._closed:
+            return
+        self.event("run_end")
+        self.heartbeat(force=True)
+        self.write_summary()
+        self._closed = True
+        self.enabled = False
+        self.step_events = False
+        if self._events is not None:
+            self._events.close()
+
+
+# -- process-wide instance -----------------------------------------------------
+
+_default = TelemetryRegistry(enabled=False)
+_current: TelemetryRegistry = _default
+
+
+def get_registry() -> TelemetryRegistry:
+    """The process-wide registry (a disabled no-op one until
+    :func:`configure` runs)."""
+    return _current
+
+
+def configure(
+    run_dir: Optional[Union[str, Path]] = None,
+    *,
+    enabled: bool = True,
+    events: bool = True,
+    heartbeat_every_s: float = 30.0,
+    step_events: bool = True,
+) -> TelemetryRegistry:
+    """Install a fresh process-wide registry (closing any previous one)
+    and return it.  ``enabled=False`` installs a disabled registry —
+    useful to guarantee a clean slate."""
+    global _current
+    if _current is not _default:
+        _current.close()
+    _current = TelemetryRegistry(
+        run_dir=run_dir,
+        enabled=enabled,
+        events=events,
+        heartbeat_every_s=heartbeat_every_s,
+        step_events=step_events,
+    )
+    return _current
+
+
+def reset() -> None:
+    """Close any configured registry and restore the disabled default
+    (tests)."""
+    global _current
+    if _current is not _default:
+        _current.close()
+    _current = _default

@@ -1,0 +1,168 @@
+"""Render a run directory's telemetry into a human summary.
+
+``python -m memvul_tpu telemetry-report <run_dir>`` — the operator's
+first stop on any run that died, stalled, or just finished: a phase
+table, step-time percentiles, counter totals, and the last-heartbeat
+age, all reconstructed from whatever subset of the three sink files
+survived (a SIGKILLed run legitimately leaves only a torn
+``events.jsonl`` and a stale ``HEARTBEAT.json`` — the report renders
+those too, it never requires a clean shutdown).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+from .sinks import HeartbeatFile, SummaryFile, read_jsonl
+
+
+def load_run(run_dir: Union[str, Path]) -> Dict[str, Any]:
+    """Everything readable from a run dir's telemetry sinks."""
+    run_dir = Path(run_dir)
+    events, skipped = read_jsonl(run_dir / "events.jsonl")
+    return {
+        "run_dir": run_dir,
+        "events": events,
+        "events_skipped": skipped,
+        "summary": SummaryFile(run_dir / "telemetry.json").read(),
+        "heartbeat": HeartbeatFile(run_dir / "HEARTBEAT.json").read(),
+    }
+
+
+def _span_table(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Aggregate ``span`` events by name: count / total / mean / max."""
+    table: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("kind") != "span":
+            continue
+        name = str(ev.get("name"))
+        try:
+            dur = float(ev.get("dur_s", 0.0))
+        except (TypeError, ValueError):
+            continue
+        row = table.setdefault(name, {"count": 0.0, "total_s": 0.0, "max_s": 0.0})
+        row["count"] += 1
+        row["total_s"] += dur
+        row["max_s"] = max(row["max_s"], dur)
+    for row in table.values():
+        row["mean_s"] = row["total_s"] / row["count"] if row["count"] else 0.0
+    return table
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.3f}s"
+
+
+def _fmt_num(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str:
+    """The human summary as one string (the CLI prints it verbatim)."""
+    data = load_run(run_dir)
+    events = data["events"]
+    summary = data["summary"]
+    heartbeat = data["heartbeat"]
+    now = time.time() if now is None else now
+
+    lines: List[str] = []
+    lines.append(f"telemetry report: {data['run_dir']}")
+    lines.append(
+        f"  events: {len(events)} parsed"
+        + (f", {data['events_skipped']} torn/unparseable skipped"
+           if data["events_skipped"] else "")
+    )
+    if not (events or summary or heartbeat):
+        lines.append("  (no telemetry sinks found in this directory)")
+        return "\n".join(lines)
+
+    # -- liveness -------------------------------------------------------------
+    if heartbeat:
+        written = heartbeat.get("written_wall")
+        age = (now - float(written)) if written is not None else None
+        lines.append("")
+        lines.append("HEARTBEAT")
+        lines.append(
+            f"  phase: {heartbeat.get('phase', '?')}"
+            f"  pid: {heartbeat.get('pid', '?')}"
+            f"  uptime: {_fmt_s(heartbeat.get('uptime_s'))}"
+        )
+        lines.append(
+            f"  last written: {_fmt_s(age)} ago"
+            + ("  (stale?)" if age is not None and age > 300 else "")
+        )
+        for key in ("rows_per_sec", "eta_s"):
+            if key in heartbeat and heartbeat[key] is not None:
+                lines.append(f"  {key}: {_fmt_num(heartbeat[key])}")
+
+    # -- phases ---------------------------------------------------------------
+    spans = _span_table(events)
+    if spans:
+        lines.append("")
+        lines.append("PHASES (spans)")
+        lines.append(
+            f"  {'name':<28} {'count':>6} {'total':>10} {'mean':>10} {'max':>10}"
+        )
+        for name in sorted(spans, key=lambda n: -spans[n]["total_s"]):
+            row = spans[name]
+            lines.append(
+                f"  {name:<28} {int(row['count']):>6}"
+                f" {_fmt_s(row['total_s']):>10}"
+                f" {_fmt_s(row['mean_s']):>10}"
+                f" {_fmt_s(row['max_s']):>10}"
+            )
+
+    # -- timing histograms ----------------------------------------------------
+    hists = {
+        name: h
+        for name, h in (summary.get("histograms") or {}).items()
+        if h and not name.startswith("span.")
+    }
+    if hists:
+        lines.append("")
+        lines.append("TIMINGS")
+        for name in sorted(hists):
+            h = hists[name]
+            lines.append(
+                f"  {name}: count={int(h.get('count', 0))}"
+                f" mean={_fmt_num(h.get('mean'))}"
+                f" p50={_fmt_num(h.get('p50'))}"
+                f" p95={_fmt_num(h.get('p95'))}"
+                f" max={_fmt_num(h.get('max'))}"
+            )
+
+    # -- counters / gauges ----------------------------------------------------
+    counters = dict(summary.get("counters") or {})
+    if not counters:
+        counters = dict(heartbeat.get("counters") or {})
+    if counters:
+        lines.append("")
+        lines.append("COUNTERS")
+        for name in sorted(counters):
+            lines.append(f"  {name} = {_fmt_num(counters[name])}")
+    gauges = summary.get("gauges") or {}
+    if gauges:
+        lines.append("")
+        lines.append("GAUGES")
+        for name in sorted(gauges):
+            lines.append(f"  {name} = {_fmt_num(gauges[name])}")
+
+    # -- last events ----------------------------------------------------------
+    if events:
+        lines.append("")
+        lines.append("LAST EVENTS")
+        for ev in events[-5:]:
+            kind = ev.get("kind", "?")
+            detail = {
+                k: v for k, v in ev.items()
+                if k not in ("t", "mono", "kind", "phase")
+            }
+            lines.append(
+                f"  +{_fmt_num(ev.get('mono', '?'))}s {kind}"
+                + (f" {detail}" if detail else "")
+            )
+    return "\n".join(lines)

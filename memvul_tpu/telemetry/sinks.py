@@ -1,0 +1,109 @@
+"""Telemetry sinks — the on-disk formats a run directory accumulates.
+
+Three files, three durability stories (docs/observability.md):
+
+* ``events.jsonl`` — the append-only event stream.  One self-contained
+  JSON object per line, flushed per write, so a SIGKILL can tear at
+  most the final line; every reader (:mod:`.report`, the chaos tests)
+  skips an unparseable tail — the same torn-tail contract as
+  ``resilience.journal.ScoreJournal``.
+* ``telemetry.json`` — the rolled-up summary (counters, gauges,
+  histogram percentiles), rewritten whole through
+  ``resilience.io.atomic_write_text`` so readers only ever see a
+  complete document.
+* ``HEARTBEAT.json`` — the liveness file, same atomic-write contract.
+  A supervisor polls it to tell a stalled run from a slow one: the
+  payload carries the current phase plus monotonic *and* wall
+  timestamps of the last progress event (registry.py documents the
+  protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Any, Dict, List, Tuple, Union
+
+
+class JsonlSink:
+    """Append-only JSONL event stream (one flushed line per event)."""
+
+    def __init__(self, path: Union[str, Path]) -> None:
+        self.path = Path(path)
+        self._f = None
+        self._lock = threading.Lock()
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._f is None:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                self._f = open(self.path, "a", encoding="utf-8")
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def read_jsonl(path: Union[str, Path]) -> Tuple[List[Dict[str, Any]], int]:
+    """Parse a JSONL stream, tolerating a torn tail.
+
+    Returns ``(records, n_skipped)``.  Unparseable or non-dict lines are
+    skipped rather than fatal — a SIGKILL mid-write legitimately leaves
+    half a line, and a report over a crashed run must still render.
+    """
+    path = Path(path)
+    if not path.exists():
+        return [], 0
+    records: List[Dict[str, Any]] = []
+    skipped = 0
+    for line in path.read_text(encoding="utf-8").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            skipped += 1
+            continue
+        if isinstance(obj, dict):
+            records.append(obj)
+        else:
+            skipped += 1
+    return records, skipped
+
+
+class AtomicJsonFile:
+    """Whole-document JSON snapshot via tmp + ``os.replace``."""
+
+    def __init__(self, path: Union[str, Path]) -> None:
+        self.path = Path(path)
+
+    def write(self, payload: Dict[str, Any]) -> None:
+        # lazy import: resilience.journal/retry count into telemetry, so
+        # the telemetry package must not import resilience at load time
+        from ..resilience.io import atomic_write_text
+
+        atomic_write_text(self.path, json.dumps(payload, indent=2, default=str))
+
+    def read(self) -> Dict[str, Any]:
+        """The current snapshot, or {} when absent/unreadable (a report
+        over a crashed or pre-telemetry run dir must still render)."""
+        try:
+            obj = json.loads(self.path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return {}
+        return obj if isinstance(obj, dict) else {}
+
+
+class HeartbeatFile(AtomicJsonFile):
+    """The liveness snapshot (``HEARTBEAT.json``)."""
+
+
+class SummaryFile(AtomicJsonFile):
+    """The rolled-up run summary (``telemetry.json``)."""

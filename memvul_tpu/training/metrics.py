@@ -44,12 +44,18 @@ def drain_pending(
     losses: List[float],
     running: Optional["RunningClassification"] = None,
     what: str = "loss",
+    extras: Optional[Dict[str, List[float]]] = None,
 ) -> None:
     """Pull a window of in-flight per-step stats to the host in ONE
     transfer (the epoch loops' only blocking point) and fold them into
     host accumulators.  The NaN guard fires here, attributed to the
     absolute step index.  ``pending`` entries are either stats dicts
-    ({"loss", "confusion"}) or bare loss scalars."""
+    ({"loss", "confusion"}) or bare loss scalars.
+
+    ``extras`` maps additional scalar stat keys (e.g. ``"grad_norm"``)
+    to host lists they accumulate into, parallel to ``losses`` — how the
+    telemetry layer gets its per-step values out of the same single
+    transfer."""
     if not pending:
         return
     first_step = current_step - len(pending)
@@ -58,6 +64,10 @@ def drain_pending(
         if np.isnan(loss):
             raise FloatingPointError(f"NaN {what} at step {first_step + offset}")
         losses.append(loss)
+        if extras is not None and isinstance(stats, dict):
+            for key, sink in extras.items():
+                if key in stats:
+                    sink.append(float(stats[key]))
         if running is not None and isinstance(stats, dict):
             running.update_confusion(stats["confusion"])
     pending.clear()

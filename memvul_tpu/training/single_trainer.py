@@ -20,11 +20,13 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from ..data.batching import LABELS_BINARY, CachedEncoder, batches_from_instances, prefetch
 from ..data.readers import DatasetReader
 from ..models.losses import masked_cross_entropy
 from ..parallel.mesh import replicate, shard_batch
+from ..telemetry import get_registry
 from .checkpoint import MetricTracker, TrainCheckpointer
 from .metrics import RunningClassification, device_confusion, drain_pending
 from .optim import make_optimizer
@@ -43,12 +45,14 @@ def make_classifier_step(model, tx):
     per-step transfer."""
 
     def loss_fn(params, batch, rng):
-        logits = model.apply(
-            params, batch["sample1"], deterministic=False, rngs={"dropout": rng}
-        )
-        loss = masked_cross_entropy(
-            logits.astype(jnp.float32), batch["label"], batch["weight"]
-        )
+        with jax.named_scope("classifier_forward"):
+            logits = model.apply(
+                params, batch["sample1"], deterministic=False, rngs={"dropout": rng}
+            )
+        with jax.named_scope("cross_entropy"):
+            loss = masked_cross_entropy(
+                logits.astype(jnp.float32), batch["label"], batch["weight"]
+            )
         return loss, logits
 
     def step(params, opt_state, rng, batch):
@@ -56,12 +60,14 @@ def make_classifier_step(model, tx):
         (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, batch, sub
         )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: p + u.astype(p.dtype), params, updates
-        )
+        with jax.named_scope("optimizer_apply"):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates
+            )
         stats = {
             "loss": loss,
+            "grad_norm": optax.global_norm(grads),
             "confusion": device_confusion(
                 logits, batch["label"], batch["weight"]
             ),
@@ -161,8 +167,18 @@ class ClassifierTrainer:
         self.metrics_history: List[Dict[str, Any]] = []
         from .trainer import jit_step
 
+        # recompile probe (same contract as MemoryTrainer): the wrapper
+        # body runs only when jit traces
+        self.train_trace_count = 0
+        raw_step = make_classifier_step(self.model, self.tx)
+
+        def traced_step(*args):
+            self.train_trace_count += 1
+            get_registry().counter("train.recompiles").inc()
+            return raw_step(*args)
+
         self._step_fn = jit_step(
-            make_classifier_step(self.model, self.tx),
+            traced_step,
             donate=(0, 1, 2),
             debug_checks=c.debug_checks,
         )
@@ -190,38 +206,78 @@ class ClassifierTrainer:
         c = self.config
         from ..utils.profiling import StepTimer, device_memory_stats
 
+        tel = get_registry()
         running = RunningClassification(2, ["neg", "pos"])
         losses: List[float] = []
+        grad_norms: List[float] = []
         pending: List[Dict] = []
         timer = StepTimer()
+        tokens_per_batch = 0
         started = time.perf_counter()
 
         def drain() -> None:
-            # the loop's only blocking transfer; NaN guard lives here
-            drain_pending(pending, _host_fetch, self.step, losses, running)
+            # the loop's only blocking transfer; NaN guard lives here.
+            # Telemetry events ride the drained window (drain cadence,
+            # never per step)
+            n_before = len(losses)
+            drain_pending(
+                pending, _host_fetch, self.step, losses, running,
+                extras={"grad_norm": grad_norms},
+            )
+            new = losses[n_before:]
+            if not new:
+                return
+            tel.counter("train.steps").inc(len(new))
+            if tel.step_events:
+                first = self.step - len(new)
+                new_norms = grad_norms[n_before:]
+                for offset, loss in enumerate(new):
+                    fields = {"step": first + offset, "loss": round(loss, 6)}
+                    if offset < len(new_norms):
+                        fields["grad_norm"] = round(new_norms[offset], 6)
+                    tel.event("train_step", **fields)
+            tel.heartbeat()
 
-        for i, batch in enumerate(self._batches()):
-            if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
-                break
-            with timer.step():
-                self.params, self.opt_state, self.rng, stats = self._step_fn(
-                    self.params, self.opt_state, self.rng, batch
-                )
-                pending.append(stats)
-                self.step += 1
-            if len(pending) >= max(1, c.sync_every):
+        with tel.span("train_epoch", epoch=self.epoch):
+            for i, batch in enumerate(self._batches()):
+                if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
+                    break
+                if not tokens_per_batch:
+                    tokens_per_batch = int(batch["sample1"]["input_ids"].size)
+                with timer.step():
+                    self.params, self.opt_state, self.rng, stats = self._step_fn(
+                        self.params, self.opt_state, self.rng, batch
+                    )
+                    pending.append(stats)
+                    self.step += 1
+                if len(pending) >= max(1, c.sync_every):
+                    with timer.distribute_over_last(len(pending)):
+                        drain()
+            if pending:
                 with timer.distribute_over_last(len(pending)):
                     drain()
-        if pending:
-            with timer.distribute_over_last(len(pending)):
-                drain()
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started
         metrics["num_steps"] = len(losses)
+        tokens_total = tokens_per_batch * len(losses)
+        metrics["tokens_per_sec"] = tokens_total / max(
+            metrics["epoch_seconds"], 1e-9
+        )
         metrics.update(timer.summary())
-        for key, value in device_memory_stats().items():
+        for key, value in device_memory_stats(all_devices=True).items():
             metrics[f"memory_{key}"] = value
+        if tel.enabled:
+            step_hist = tel.histogram("train.step_s")
+            for d in timer.durations:
+                step_hist.observe(d)
+            tel.counter("train.tokens").inc(tokens_total)
+            tel.gauge("train.tokens_per_sec").set(metrics["tokens_per_sec"])
+            tel.event(
+                "train_epoch",
+                epoch=self.epoch,
+                **{k: v for k, v in metrics.items() if isinstance(v, (int, float))},
+            )
         return metrics
 
     def validate(self) -> Dict[str, float]:
@@ -266,7 +322,8 @@ class ClassifierTrainer:
             epoch_metrics.update(
                 {f"training_{k}": v for k, v in self.train_epoch().items()}
             )
-            val = self.validate()
+            with get_registry().span("validate", epoch=self.epoch):
+                val = self.validate()
             epoch_metrics.update({f"validation_{k}": v for k, v in val.items()})
             self.metrics_history.append(epoch_metrics)
             logger.info("epoch %d: %s", self.epoch, epoch_metrics)

@@ -37,6 +37,7 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from ..data.batching import LABELS_SIAMESE, CachedEncoder, batches_from_instances, prefetch
 from ..data.readers import MemoryReader
@@ -44,9 +45,10 @@ from ..models.memory import MemoryModel, pair_loss
 from ..parallel.mesh import replicate, shard_batch
 from ..resilience import faults
 from ..resilience.io import atomic_write_text
+from ..telemetry import get_registry
 from .checkpoint import MetricTracker, TrainCheckpointer
 from .metrics import RunningClassification, device_confusion, drain_pending
-from .optim import make_optimizer
+from .optim import linear_with_warmup, make_optimizer, make_schedule
 
 logger = logging.getLogger(__name__)
 
@@ -90,16 +92,21 @@ def make_train_step(model: MemoryModel, tx, ema_decay: Optional[float] = None):
     temperature = model.temperature
 
     def loss_fn(params, microbatch, rng):
-        logits = model.apply(
-            params,
-            microbatch["sample1"],
-            microbatch["sample2"],
-            deterministic=False,
-            rngs={"dropout": rng},
-        )
-        loss = pair_loss(
-            logits, microbatch["label"], microbatch["weight"], temperature
-        )
+        # named scopes: jax.profiler traces (and jaxpr name stacks)
+        # attribute time to "siamese_forward"/"pair_loss" instead of an
+        # anonymous fused blob (docs/observability.md, named-scope map)
+        with jax.named_scope("siamese_forward"):
+            logits = model.apply(
+                params,
+                microbatch["sample1"],
+                microbatch["sample2"],
+                deterministic=False,
+                rngs={"dropout": rng},
+            )
+        with jax.named_scope("pair_loss"):
+            loss = pair_loss(
+                logits, microbatch["label"], microbatch["weight"], temperature
+            )
         return loss, logits
 
     def _core(params, opt_state, rng, stack):
@@ -118,12 +125,16 @@ def make_train_step(model: MemoryModel, tx, ema_decay: Optional[float] = None):
         )
         k = stack["label"].shape[0]
         grads = jax.tree_util.tree_map(lambda g: g / k, grads)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda p, u: p + u.astype(p.dtype), params, updates
-        )
+        with jax.named_scope("optimizer_apply"):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates
+            )
         stats = {
             "loss": loss_sum / k,
+            # pre-clip global gradient norm — rides back with the stats
+            # window (one scalar), surfaced as a per-step telemetry event
+            "grad_norm": optax.global_norm(grads),
             "confusion": device_confusion(
                 logits, stack["label"], stack["weight"]
             ),
@@ -297,11 +308,29 @@ class MemoryTrainer:
         self.ema_params = None
         if c.ema_decay is not None:
             self.ema_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        # host-side lr mirror of the optimizer's schedule — per-step lr
+        # in the telemetry events without pulling it off the device
+        self._lr_scale = (
+            make_schedule(c.learning_rate_scheduler)
+            if c.learning_rate_scheduler
+            else linear_with_warmup(c.warmup_steps, total_steps)
+        )
+        # recompile probe: the wrapper body runs at TRACE time only, so
+        # the counter ticks exactly when jit misses its cache (a new
+        # stack shape mid-run = a silent multi-second stall on TPU)
+        self.train_trace_count = 0
+        raw_step = make_train_step(self.model, self.tx, ema_decay=c.ema_decay)
+
+        def traced_step(*args):
+            self.train_trace_count += 1
+            get_registry().counter("train.recompiles").inc()
+            return raw_step(*args)
+
         # EMA rides inside the one jitted step (no second dispatch); input
         # state buffers are donated so base-geometry params/opt-state don't
         # double-buffer in HBM
         self._train_step = jit_step(
-            make_train_step(self.model, self.tx, ema_decay=c.ema_decay),
+            traced_step,
             donate=(0, 1, 2, 3) if c.ema_decay is not None else (0, 1, 2),
             debug_checks=c.debug_checks,
         )
@@ -376,27 +405,58 @@ class MemoryTrainer:
 
     # -- epoch orchestration ---------------------------------------------------
 
-    def _drain_stats(self, pending, running, losses) -> None:
+    def _lr_at(self, step: int) -> float:
+        """Host-side learning rate at a step (base group's rate — the
+        schedule scale times ``base_lr``), for the telemetry events."""
+        return float(self._lr_scale(step)) * self.config.base_lr
+
+    def _drain_stats(self, pending, running, losses, grad_norms=None) -> None:
         """One blocking transfer per window; NaN guard fires here
-        (reference NaN check: custom_trainer.py:403-404)."""
+        (reference NaN check: custom_trainer.py:403-404).  Telemetry
+        rides the same drain: the per-step events are emitted from the
+        freshly pulled window, so a disabled registry costs the step
+        loop nothing."""
         n_before = len(losses)
-        drain_pending(pending, _host_fetch, self.step, losses, running)
+        drain_pending(
+            pending, _host_fetch, self.step, losses, running,
+            extras={"grad_norm": grad_norms} if grad_norms is not None else None,
+        )
+        new = losses[n_before:]
+        if not new:
+            return
+        first = self.step - len(new)
         log_path = self.config.step_loss_log
-        if log_path and len(losses) > n_before:
-            new = losses[n_before:]
-            first = self.step - len(new)
+        if log_path:
             with open(log_path, "a") as f:
                 for offset, loss in enumerate(new):
                     f.write(json.dumps({"step": first + offset, "loss": loss}) + "\n")
+        tel = get_registry()
+        tel.counter("train.steps").inc(len(new))
+        if tel.step_events:
+            new_norms = grad_norms[n_before:] if grad_norms is not None else []
+            for offset, loss in enumerate(new):
+                step = first + offset
+                fields = {
+                    "step": step,
+                    "loss": round(loss, 6),
+                    "lr": self._lr_at(step),
+                }
+                if offset < len(new_norms):
+                    fields["grad_norm"] = round(new_norms[offset], 6)
+                tel.event("train_step", **fields)
+        tel.heartbeat()
 
     def train_epoch(self) -> Dict[str, float]:
         c = self.config
         from ..utils.profiling import StepTimer, device_memory_stats, trace_context
 
+        tel = get_registry()
         running = RunningClassification(2, ["same", "diff"])
         losses: List[float] = []
+        grad_norms: List[float] = []
         pending: List[Dict] = []
         timer = StepTimer()
+        tokens_per_stack = 0  # constant across the epoch (pad_to_max)
         started = time.perf_counter()
         trace_dir = c.profile_dir if (c.profile_dir and self.epoch == 0) else None
         # mid-epoch resume: the epoch's stream is replayed from its
@@ -406,12 +466,14 @@ class MemoryTrainer:
         skip = self._resume_skip_stacks
         self._resume_skip_stacks = 0
         self._epoch_stacks_done = skip
-        with trace_context(trace_dir):
+        with tel.span("train_epoch", epoch=self.epoch), trace_context(trace_dir):
             for i, stack in enumerate(self._microbatch_stacks()):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
                 if i < skip:
                     continue
+                if not tokens_per_stack:
+                    tokens_per_stack = int(stack["sample1"]["input_ids"].size)
                 # chaos hook: "step.<global step index>" fires at the
                 # start of the step (docs/fault_tolerance.md)
                 faults.fault_point(f"step.{self.step}")
@@ -435,14 +497,14 @@ class MemoryTrainer:
                 self._epoch_stacks_done = i + 1
                 if len(pending) >= max(1, c.sync_every):
                     with timer.distribute_over_last(len(pending)):
-                        self._drain_stats(pending, running, losses)
+                        self._drain_stats(pending, running, losses, grad_norms)
                 if (
                     c.save_every_steps
                     and self.checkpointer is not None
                     and self.step % c.save_every_steps == 0
                 ):
                     with timer.distribute_over_last(max(1, len(pending))):
-                        self._drain_stats(pending, running, losses)
+                        self._drain_stats(pending, running, losses, grad_norms)
                     self._save_step_checkpoint()
                 if self._stop_signal is not None:
                     # the in-flight step above completed; leave the rest
@@ -457,15 +519,32 @@ class MemoryTrainer:
                     break
             if pending:
                 with timer.distribute_over_last(len(pending)):
-                    self._drain_stats(pending, running, losses)
+                    self._drain_stats(pending, running, losses, grad_norms)
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started
         metrics["num_steps"] = len(losses)
+        tokens_total = tokens_per_stack * len(losses)
+        metrics["tokens_per_sec"] = tokens_total / max(
+            metrics["epoch_seconds"], 1e-9
+        )
         metrics.update(timer.summary())
-        # peak-memory-in-metrics behavior (reference: custom_trainer.py:674-679)
-        for key, value in device_memory_stats().items():
+        # peak-memory-in-metrics behavior (reference: custom_trainer.py:
+        # 674-679), summed across ALL local devices — a sharded run's
+        # footprint lives on every chip, not jax.devices()[0]
+        for key, value in device_memory_stats(all_devices=True).items():
             metrics[f"memory_{key}"] = value
+        if tel.enabled:
+            step_hist = tel.histogram("train.step_s")
+            for d in timer.durations:
+                step_hist.observe(d)
+            tel.counter("train.tokens").inc(tokens_total)
+            tel.gauge("train.tokens_per_sec").set(metrics["tokens_per_sec"])
+            tel.event(
+                "train_epoch",
+                epoch=self.epoch,
+                **{k: v for k, v in metrics.items() if isinstance(v, (int, float))},
+            )
         return metrics
 
     def validate(self) -> Dict[str, float]:
@@ -584,6 +663,13 @@ class MemoryTrainer:
             "preempted by signal %s at step %d — resumable state saved",
             self._stop_signal, self.step,
         )
+        tel = get_registry()
+        tel.counter("train.preemptions").inc()
+        tel.event(
+            "preempted",
+            signal=self._stop_signal, epoch=self.epoch, step=self.step,
+        )
+        tel.heartbeat(force=True)
 
     def train(self) -> Dict[str, Any]:
         c = self.config
@@ -608,7 +694,8 @@ class MemoryTrainer:
                 epoch_metrics.update(
                     {f"training_{k}": v for k, v in train_metrics.items()}
                 )
-                val = self.validate()
+                with get_registry().span("validate", epoch=self.epoch):
+                    val = self.validate()
                 epoch_metrics.update({f"validation_{k}": v for k, v in val.items()})
                 self.metrics_history.append(epoch_metrics)
                 logger.info("epoch %d: %s", self.epoch, epoch_metrics)
@@ -621,12 +708,13 @@ class MemoryTrainer:
                         self.epoch,
                     )
                 if self.checkpointer is not None:
-                    self.checkpointer.save(
-                        self.epoch,
-                        self._state_dict(),
-                        is_best=is_best,
-                        metadata=epoch_metrics,
-                    )
+                    with get_registry().span("checkpoint", epoch=self.epoch):
+                        self.checkpointer.save(
+                            self.epoch,
+                            self._state_dict(),
+                            is_best=is_best,
+                            metadata=epoch_metrics,
+                        )
                 self.epoch += 1
                 self._epoch_stacks_done = 0
                 if val and self.tracker.should_stop():

@@ -75,6 +75,12 @@ class StepTimer:
     def __len__(self) -> int:
         return len(self._durations)
 
+    @property
+    def durations(self) -> tuple:
+        """The recorded per-step durations (copy) — what the telemetry
+        layer feeds into its ``train.step_s`` histogram at epoch end."""
+        return tuple(self._durations)
+
     def summary(self, prefix: str = "step_") -> Dict[str, float]:
         """Timing summary; the first (compile-bearing) step is excluded
         from the steady-state stats and reported as ``first_s``."""
@@ -101,18 +107,44 @@ class StepTimer:
         self._durations.clear()
 
 
-def device_memory_stats(device: Optional[jax.Device] = None) -> Dict[str, float]:
-    """Live/peak memory for one device (the reference folds peak memory
-    into epoch metrics, custom_trainer.py:674-679).  Returns {} when the
-    backend exposes no stats (e.g. CPU)."""
-    device = device or jax.devices()[0]
-    stats = getattr(device, "memory_stats", lambda: None)()
-    if not stats:
-        return {}
+_MEMORY_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_stats(
+    device: Optional[jax.Device] = None, all_devices: bool = False
+) -> Dict[str, float]:
+    """Live/peak memory stats (the reference folds peak memory into epoch
+    metrics, custom_trainer.py:674-679).  Returns {} when the backend
+    exposes no stats (e.g. CPU).
+
+    Default: one device (``device`` or ``jax.devices()[0]``) — the
+    historical behavior.  With ``all_devices=True`` every local device is
+    polled: the three byte keys are **summed** across reporting devices
+    (a sharded run's true HBM footprint), each device's peak also comes
+    back as ``peak_bytes_in_use_device<i>`` (the imbalance view — one
+    hot shard OOMs a pod whose *sum* looks fine), and
+    ``devices_reporting`` counts how many devices answered.
+    """
+    if not all_devices:
+        device = device or jax.devices()[0]
+        stats = getattr(device, "memory_stats", lambda: None)()
+        if not stats:
+            return {}
+        return {k: float(stats[k]) for k in _MEMORY_KEYS if k in stats}
     out: Dict[str, float] = {}
-    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
-        if key in stats:
-            out[key] = float(stats[key])
+    reporting = 0
+    for i, dev in enumerate(jax.local_devices()):
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if not stats:
+            continue
+        reporting += 1
+        for key in _MEMORY_KEYS:
+            if key in stats:
+                out[key] = out.get(key, 0.0) + float(stats[key])
+        if "peak_bytes_in_use" in stats:
+            out[f"peak_bytes_in_use_device{i}"] = float(stats["peak_bytes_in_use"])
+    if reporting:
+        out["devices_reporting"] = float(reporting)
     return out
 
 

@@ -408,8 +408,10 @@ def test_scoring_heartbeat_logged(ws, memory_setup, tmp_path, caplog):
         )
     beats = [r for r in caplog.records if "scoring heartbeat" in r.message]
     assert beats, "no heartbeat logged"
-    # reports/s + journal total + quarantine count all present
-    assert "reports/s" in beats[0].getMessage()
+    # rows/s + ETA + journal total + quarantine count all present (the
+    # rate/ETA sourcing lives in tests/test_telemetry.py)
+    assert "rows/s" in beats[0].getMessage()
+    assert "ETA" in beats[0].getMessage()
     assert "quarantined" in beats[0].getMessage()
 
 

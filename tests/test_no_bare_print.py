@@ -1,0 +1,40 @@
+"""Tier-1 invariant: no bare ``print(`` in memvul_tpu library code
+(tools/lint_no_bare_print.py) — library output goes through logging or
+the telemetry registry; only bench.py/__main__.py own stdout."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_no_bare_print import find_bare_prints, main  # noqa: E402
+
+
+def test_package_has_no_bare_prints():
+    offenders = find_bare_prints(REPO / "memvul_tpu")
+    assert offenders == [], (
+        "bare print() in library code (use logging / telemetry, "
+        f"docs/observability.md): {offenders}"
+    )
+
+
+def test_lint_flags_a_planted_offender(tmp_path):
+    (tmp_path / "bad.py").write_text("def f():\n    print('oops')\n")
+    (tmp_path / "ok.py").write_text(
+        "SRC = 'print(\"in a string is fine\")'\n"
+        "import logging\nlogging.getLogger(__name__).info('fine')\n"
+    )
+    (tmp_path / "bench.py").write_text("print('exempt')\n")
+    offenders = find_bare_prints(tmp_path)
+    assert len(offenders) == 1 and offenders[0].endswith("bad.py:2")
+
+
+def test_lint_cli_exit_codes(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    assert main([str(tmp_path)]) == 0
+    (tmp_path / "bad.py").write_text("print(1)\n")
+    assert main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py:1" in out
+    assert main([str(tmp_path / "missing")]) == 2

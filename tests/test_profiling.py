@@ -31,10 +31,80 @@ def test_step_timer_single_step():
     assert "step_mean_s" not in s  # no steady-state stats from one step
 
 
+def test_step_timer_distribute_over_last_clamps_n():
+    """``distribute_over_last`` with n larger than the recorded steps
+    spreads over what exists — never indexes past the front."""
+    timer = StepTimer()
+    for _ in range(3):
+        with timer.step():
+            pass
+    before = sum(timer.durations)
+    with timer.distribute_over_last(100):
+        time.sleep(0.03)
+    assert len(timer) == 3  # no phantom step appended
+    added = sum(timer.durations) - before
+    assert added >= 0.03
+    # the drain's cost was spread over all three recorded steps
+    assert all(d >= added / 3 * 0.5 for d in timer.durations)
+
+
+def test_step_timer_distribute_over_last_empty():
+    """With no recorded steps the drain's time becomes one synthetic
+    step instead of being silently dropped."""
+    timer = StepTimer()
+    with timer.distribute_over_last(5):
+        time.sleep(0.01)
+    assert len(timer) == 1
+    assert timer.summary()["step_total_s"] >= 0.01
+
+
+def test_step_timer_durations_property_is_a_copy():
+    timer = StepTimer()
+    with timer.step():
+        pass
+    snap = timer.durations
+    with timer.step():
+        pass
+    assert len(snap) == 1 and len(timer.durations) == 2
+
+
 def test_device_memory_stats_shape():
     stats = device_memory_stats()
     # CPU backend may expose nothing; when present the values are floats
     for v in stats.values():
+        assert isinstance(v, float)
+
+
+def test_device_memory_stats_all_devices(monkeypatch):
+    """all_devices=True sums the byte keys over reporting devices and
+    exposes each device's peak (the imbalance view)."""
+
+    class FakeDev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    fakes = [
+        FakeDev({"bytes_in_use": 10.0, "peak_bytes_in_use": 30.0,
+                 "bytes_limit": 100.0}),
+        FakeDev(None),  # a backend that exposes nothing
+        FakeDev({"bytes_in_use": 5.0, "peak_bytes_in_use": 50.0,
+                 "bytes_limit": 100.0}),
+    ]
+    monkeypatch.setattr(jax, "local_devices", lambda: fakes)
+    stats = device_memory_stats(all_devices=True)
+    assert stats["bytes_in_use"] == 15.0
+    assert stats["peak_bytes_in_use"] == 80.0
+    assert stats["bytes_limit"] == 200.0
+    assert stats["peak_bytes_in_use_device0"] == 30.0
+    assert stats["peak_bytes_in_use_device2"] == 50.0
+    assert "peak_bytes_in_use_device1" not in stats
+    assert stats["devices_reporting"] == 2.0
+    # the CPU backend path stays {} (nothing reports)
+    real = device_memory_stats(all_devices=False)
+    for v in real.values():
         assert isinstance(v, float)
 
 

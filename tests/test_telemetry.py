@@ -1,0 +1,408 @@
+"""The unified run-telemetry subsystem (docs/observability.md).
+
+Acceptance contracts proven here:
+
+* a chaos-interrupted scoring run leaves a readable JSONL event stream
+  and a ``HEARTBEAT.json`` whose committed-row counters match the
+  journal, and ``telemetry-report`` renders the run dir without error;
+* with telemetry disabled the accessors are shared no-op singletons and
+  a trainer epoch emits zero events (no per-step host work added);
+* enabled, the trainer emits per-step loss/grad-norm/lr events at drain
+  cadence plus epoch rollups, and the recompile counter ticks once;
+* the ``jax.named_scope`` map is present in the jaxpr name stacks of
+  the train and score programs (what makes trace_context profiles
+  attributable — assertable on CPU).
+
+Everything is CPU + tiny geometry.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu import telemetry
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.resilience import faults
+from memvul_tpu.telemetry import read_jsonl
+from memvul_tpu.telemetry.registry import (
+    NULL_COUNTER,
+    NULL_GAUGE,
+    NULL_HISTOGRAM,
+    Histogram,
+    TelemetryRegistry,
+)
+from memvul_tpu.telemetry.report import render_report
+from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+WS_SEED = 7
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    faults.reset()
+    yield
+    telemetry.reset()
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("telemetry"), seed=WS_SEED)
+
+
+@pytest.fixture(scope="module")
+def memory_setup(ws):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    return model, params, reader
+
+
+def make_predictor(ws, memory_setup, **kw):
+    model, params, reader = memory_setup
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("max_length", 64)
+    pred = SiamesePredictor(model, params, ws["tokenizer"], **kw)
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    return pred
+
+
+def make_trainer(ws, out_dir=None, **cfg_kw):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"],
+        anchor_path=ws["paths"]["anchors"],
+        same_diff_ratio={"same": 2, "diff": 2},
+        sample_neg=0.5,
+        seed=2021,
+    )
+    defaults = dict(
+        num_epochs=1, patience=None, batch_size=4, grad_accum=2,
+        max_length=32, warmup_steps=2, base_lr=1e-3, steps_per_epoch=2,
+        sync_every=1, serialization_dir=str(out_dir) if out_dir else None,
+    )
+    defaults.update(cfg_kw)
+    return MemoryTrainer(
+        model, params, ws["tokenizer"], reader,
+        train_path=ws["paths"]["train"], config=TrainerConfig(**defaults),
+    )
+
+
+# -- registry ------------------------------------------------------------------
+
+
+def test_disabled_registry_hands_back_null_singletons(tmp_path):
+    reg = TelemetryRegistry(enabled=False)
+    assert reg.counter("a") is NULL_COUNTER
+    assert reg.gauge("b") is NULL_GAUGE
+    assert reg.histogram("c") is NULL_HISTOGRAM
+    NULL_COUNTER.inc(5)
+    NULL_HISTOGRAM.observe(1.0)
+    NULL_GAUGE.set(2.0)
+    assert NULL_COUNTER.value == 0 and NULL_HISTOGRAM.count == 0
+    # liveness still tracked: spans move the phase + progress clock
+    before = reg.last_progress_monotonic
+    with reg.span("work"):
+        assert reg.phase == "work"
+    assert reg.phase == "idle"
+    assert reg.last_progress_monotonic >= before
+    assert reg.heartbeat_age_s() >= 0.0
+    # and nothing was written anywhere
+    reg.heartbeat(force=True)
+    reg.close()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_registry_sinks_roundtrip(tmp_path):
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    tel.counter("score.rows").inc(12)
+    tel.gauge("train.tokens_per_sec").set(99.5)
+    for v in (0.1, 0.2, 0.4):
+        tel.histogram("train.step_s").observe(v)
+    with tel.span("anchor_encode"):
+        pass
+    tel.event("train_step", step=0, loss=1.25)
+    tel.heartbeat(force=True, rows_per_sec=3.0)
+    tel.close()
+
+    events, skipped = read_jsonl(tmp_path / "events.jsonl")
+    assert skipped == 0
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert "span" in kinds and "train_step" in kinds
+    hb = json.loads((tmp_path / "HEARTBEAT.json").read_text())
+    assert hb["counters"]["score.rows"] == 12
+    assert {"phase", "pid", "written_wall", "last_progress_wall",
+            "last_progress_monotonic"} <= set(hb)
+    summary = json.loads((tmp_path / "telemetry.json").read_text())
+    assert summary["counters"]["score.rows"] == 12
+    assert summary["gauges"]["train.tokens_per_sec"] == 99.5
+    h = summary["histograms"]["train.step_s"]
+    assert h["count"] == 3 and abs(h["mean"] - 0.7 / 3) < 1e-9
+    assert "span.anchor_encode" in summary["histograms"]
+    # closed registry goes quiet
+    assert tel.counter("late") is NULL_COUNTER
+
+
+def test_histogram_reservoir_stays_bounded():
+    h = Histogram("x", cap=64)
+    for i in range(10_000):
+        h.observe(float(i))
+    s = h.summary()
+    assert s["count"] == 10_000 and s["min"] == 0.0 and s["max"] == 9999.0
+    assert len(h._sample) == 64
+    assert 0 < s["p50"] < 10_000
+
+
+def test_report_tolerates_torn_tail_and_missing_files(tmp_path):
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    with tel.span("phase_a"):
+        pass
+    tel.heartbeat(force=True)
+    # simulate a SIGKILL mid-append: torn final line
+    with open(tmp_path / "events.jsonl", "a") as f:
+        f.write('{"t": 1, "kind": "trunc')
+    events, skipped = read_jsonl(tmp_path / "events.jsonl")
+    assert skipped == 1 and all(e["kind"] != "trunc" for e in events)
+    text = render_report(tmp_path)
+    assert "phase_a" in text and "torn/unparseable" in text
+    telemetry.reset()
+    # an empty dir still renders
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert "no telemetry sinks" in render_report(empty)
+
+
+# -- named scopes (trace attribution, assertable on CPU) -----------------------
+
+
+def _name_stacks(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        out.add(str(eqn.source_info.name_stack))
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for x in vs:
+                if hasattr(x, "jaxpr"):
+                    _name_stacks(x.jaxpr, out)
+    return out
+
+
+def test_named_scopes_reach_the_score_program(ws, memory_setup):
+    model, params, reader = memory_setup
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    bank = np.zeros((4, model.header_dim), np.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda p, b, a: model.apply(p, b, anchors=a)
+    )(params, dummy, bank)
+    names = " | ".join(_name_stacks(jaxpr, set()))
+    for scope in ("bert_encode", "bert_embeddings", "bert_layers",
+                  "pooler", "header", "anchor_match"):
+        assert scope in names, f"named scope {scope!r} missing from jaxpr"
+
+
+def test_named_scopes_reach_the_train_step(ws, memory_setup):
+    from memvul_tpu.training.optim import make_optimizer
+    from memvul_tpu.training.trainer import make_train_step
+
+    model, params, _ = memory_setup
+    tx, opt_state = make_optimizer(params, warmup_steps=2)
+    step = make_train_step(model, tx)
+    sample = {
+        "input_ids": np.zeros((1, 2, 8), np.int32),
+        "attention_mask": np.ones((1, 2, 8), np.int32),
+    }
+    stack = {
+        "sample1": sample, "sample2": sample,
+        "label": np.zeros((1, 2), np.int32),
+        "weight": np.ones((1, 2), np.float32),
+    }
+    jaxpr = jax.make_jaxpr(step)(
+        params, opt_state, jax.random.PRNGKey(0), stack
+    )
+    names = " | ".join(_name_stacks(jaxpr, set()))
+    for scope in ("siamese_forward", "pair_loss", "optimizer_apply"):
+        assert scope in names, f"named scope {scope!r} missing from jaxpr"
+
+
+# -- trainer instrumentation ---------------------------------------------------
+
+
+def test_trainer_disabled_telemetry_zero_events(ws, monkeypatch):
+    """With the default (disabled) registry the epoch loop must add no
+    per-step host work: no sink writes of any kind, null accessors."""
+    writes = {"json": 0, "jsonl": 0}
+    from memvul_tpu.telemetry.sinks import AtomicJsonFile, JsonlSink
+
+    monkeypatch.setattr(
+        AtomicJsonFile, "write",
+        lambda self, payload: writes.__setitem__("json", writes["json"] + 1),
+    )
+    monkeypatch.setattr(
+        JsonlSink, "emit",
+        lambda self, record: writes.__setitem__("jsonl", writes["jsonl"] + 1),
+    )
+
+    trainer = make_trainer(ws)
+    metrics = trainer.train_epoch()
+    assert metrics["num_steps"] == 2
+    assert writes == {"json": 0, "jsonl": 0}
+    reg = telemetry.get_registry()
+    assert reg.counter("train.steps") is NULL_COUNTER
+    assert not reg.enabled and not reg.step_events
+
+
+def test_trainer_enabled_emits_step_events_and_counters(ws, tmp_path):
+    tel = telemetry.configure(run_dir=tmp_path / "run", heartbeat_every_s=0.0)
+    trainer = make_trainer(ws)
+    metrics = trainer.train_epoch()
+    assert metrics["num_steps"] == 2
+    assert metrics["tokens_per_sec"] > 0
+    assert trainer.train_trace_count == 1  # one trace, no recompiles
+    snap = tel.snapshot()
+    assert snap["counters"]["train.steps"] == 2
+    assert snap["counters"]["train.recompiles"] == 1
+    assert snap["counters"]["train.tokens"] > 0
+    assert snap["histograms"]["train.step_s"]["count"] == 2
+    tel.close()
+
+    events, _ = read_jsonl(tmp_path / "run" / "events.jsonl")
+    steps = [e for e in events if e["kind"] == "train_step"]
+    assert [e["step"] for e in steps] == [0, 1]
+    for e in steps:
+        assert np.isfinite(e["loss"])
+        assert e["grad_norm"] > 0
+        assert e["lr"] >= 0
+    assert steps[1]["lr"] > 0  # step 0 sits at the base of the warmup ramp
+    epochs = [e for e in events if e["kind"] == "train_epoch"]
+    assert len(epochs) == 1 and epochs[0]["num_steps"] == 2
+    hb = json.loads((tmp_path / "run" / "HEARTBEAT.json").read_text())
+    assert hb["counters"]["train.steps"] == 2
+    # the report renders the run dir without error
+    text = render_report(tmp_path / "run")
+    assert "train_epoch" in text and "train.step_s" in text
+
+
+# -- scoring instrumentation (the chaos acceptance) ----------------------------
+
+
+def test_chaos_scoring_leaves_coherent_telemetry(ws, memory_setup, tmp_path):
+    """Kill a journaled scoring run mid-stream (MEMVUL_FAULTS-style
+    injection): events.jsonl stays readable, HEARTBEAT.json's committed
+    counters match the journal, telemetry-report renders."""
+    model, params, reader = memory_setup
+    run = tmp_path / "run"
+    out = tmp_path / "scores.json"
+    tel = telemetry.configure(run_dir=run, heartbeat_every_s=0.0)
+    # @4, not @3: the inflight pipeline runs two dispatches ahead of the
+    # first yield, so earlier faults kill the stream before any batch
+    # commits (same choice as test_fault_tolerance)
+    faults.configure("score.batch@4=raise:RuntimeError:injected hard crash")
+    pred = make_predictor(ws, memory_setup)
+    with pytest.raises(RuntimeError, match="injected hard crash"):
+        pred.predict_file(
+            reader, ws["paths"]["test"], out,
+            resume=True, heartbeat_batches=1,
+        )
+    faults.reset()
+
+    journal_lines = (tmp_path / "scores.json.journal").read_text().splitlines()
+    journal_rows = sum(json.loads(l)["n"] for l in journal_lines)
+    assert journal_rows > 0  # real progress before the crash
+
+    hb = json.loads((run / "HEARTBEAT.json").read_text())
+    assert hb["counters"]["journal.rows_committed"] == journal_rows
+    assert hb["counters"]["journal.lines_committed"] == len(journal_lines)
+    events, skipped = read_jsonl(run / "events.jsonl")
+    assert events and skipped == 0
+    assert any(e["kind"] == "span" and e["name"] == "anchor_encode"
+               for e in events)
+    text = render_report(run)
+    assert "journal.rows_committed" in text and "score_stream" in text
+
+    # the resumed run completes; the FRESH registry's counters cover
+    # exactly the lines appended after the verified prefix
+    n_verified = len(journal_lines)
+    telemetry.configure(run_dir=run, heartbeat_every_s=0.0)
+    make_predictor(ws, memory_setup).predict_file(
+        reader, ws["paths"]["test"], out, resume=True,
+    )
+    telemetry.get_registry().close()
+    hb2 = json.loads((run / "HEARTBEAT.json").read_text())
+    total_lines = len((tmp_path / "scores.json.journal").read_text().splitlines())
+    assert total_lines > n_verified
+    assert hb2["counters"]["journal.lines_committed"] == total_lines - n_verified
+
+
+def test_scoring_heartbeat_reports_rate_and_eta(ws, memory_setup, tmp_path, caplog):
+    model, params, reader = memory_setup
+    n_reports = len(list(reader.read(ws["paths"]["test"], split="test")))
+    tel = telemetry.configure(run_dir=tmp_path / "run", heartbeat_every_s=0.0)
+    with caplog.at_level("INFO", logger="memvul_tpu.evaluate.predict_memory"):
+        make_predictor(ws, memory_setup).predict_file(
+            reader, ws["paths"]["test"], tmp_path / "scores.json",
+            heartbeat_batches=1, expected_reports=n_reports,
+        )
+    beats = [r.message for r in caplog.records if "scoring heartbeat" in r.message]
+    assert beats, "no heartbeat log lines at heartbeat_batches=1"
+    assert "rows/s" in beats[-1] and "ETA" in beats[-1]
+    assert "unknown" not in beats[-1]  # expected_reports given → real ETA
+    hb = json.loads((tmp_path / "run" / "HEARTBEAT.json").read_text())
+    assert hb["counters"]["score.rows"] == n_reports
+    snap = tel.snapshot()
+    assert snap["histograms"]["score.batch_latency_s"]["count"] > 0
+    occ = snap["histograms"]["score.bucket_occupancy"]
+    assert 0.0 < occ["max"] <= 1.0
+
+
+def test_telemetry_report_cli(tmp_path, capsys):
+    from memvul_tpu.__main__ import main
+
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    with tel.span("bench.timed_pass"):
+        pass
+    tel.counter("score.rows").inc(3)
+    tel.close()
+    assert main(["telemetry-report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "bench.timed_pass" in out and "score.rows = 3" in out
+    assert main(["telemetry-report", str(tmp_path / "nope")]) == 2
+
+
+def test_bench_watchdog_record_carries_heartbeat_age(monkeypatch, capsys):
+    """The rc=124 record names the stuck phase AND how long ago progress
+    last happened (stuck-phase vs slow-backend, cf. BENCH_r05)."""
+    import memvul_tpu.bench as bench
+
+    monkeypatch.setattr(bench.os, "_exit", lambda code: None)
+    wd = bench._PhaseWatchdog(timeout=5.0, metric="siamese_scoring_throughput")
+    wd._expire("timed_pass")
+    out = capsys.readouterr().out
+    record = json.loads(out.strip().splitlines()[-1])
+    assert record["phase"] == "timed_pass"
+    assert record["watchdog_timeout"] is True
+    assert isinstance(record["heartbeat_age_s"], float)
+    assert record["heartbeat_age_s"] >= 0.0

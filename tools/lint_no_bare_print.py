@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Lint: no bare ``print(`` calls in ``memvul_tpu/`` library code.
+
+Library output must go through ``logging`` (operator-facing messages)
+or the telemetry registry (machine-facing run data,
+docs/observability.md) — a bare print from deep inside a scoring stream
+corrupts the one-JSON-line stdout contract of the bench/CLI entry
+points and is invisible to telemetry-report.  The two intentional
+stdout writers are exempt: ``bench.py`` (its stdout IS the result
+contract) and ``__main__.py`` (the CLI's user-facing output).
+
+The check is AST-based, so ``print`` inside string literals (e.g. the
+doctor's subprocess probe source, utils/doctor.py) is not flagged —
+those strings execute in a child whose stdout is the parsed protocol.
+
+Usage: ``python tools/lint_no_bare_print.py [package_dir]`` — exits 1
+listing offenders, 0 when clean.  Invoked as a tier-1 test from
+``tests/test_no_bare_print.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+# files whose stdout is an intentional, documented contract
+ALLOWED_FILES = {"bench.py", "__main__.py"}
+
+
+def find_bare_prints(package_dir: Path) -> List[str]:
+    """``path:line`` for every ``print(...)`` call expression under
+    ``package_dir``, excluding :data:`ALLOWED_FILES`."""
+    offenders: List[str] = []
+    for path in sorted(package_dir.rglob("*.py")):
+        if path.name in ALLOWED_FILES:
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        except SyntaxError as e:  # a file that doesn't parse is its own bug
+            offenders.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                offenders.append(f"{path}:{node.lineno}")
+    return offenders
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        package_dir = Path(argv[0])
+    else:
+        package_dir = Path(__file__).resolve().parent.parent / "memvul_tpu"
+    if not package_dir.is_dir():
+        print(f"lint_no_bare_print: {package_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    offenders = find_bare_prints(package_dir)
+    for line in offenders:
+        print(f"bare print() in library code: {line}")
+    if offenders:
+        print(
+            f"{len(offenders)} bare print call(s) — use logging or the "
+            "telemetry registry (docs/observability.md)"
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
