@@ -38,7 +38,11 @@ BENCH_INFLIGHT (async device dispatch depth, default 2),
 BENCH_PROFILE (dir — capture a jax.profiler trace of the timed pass),
 BENCH_MICRO (anchor_match — run the isolated bank-match microbench,
 fused Pallas kernel vs decomposed einsum, instead of the full scoring
-pass; BENCH_MICRO_{B,A,D,ITERS} set its shape),
+pass, BENCH_MICRO_{B,A,D,ITERS} set its shape; serve — drive the online
+scoring service (docs/serving.md) with closed-loop in-process clients
+and report request throughput + latency percentiles,
+BENCH_MICRO_REQUESTS/BENCH_MICRO_CLIENTS set the load,
+BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher),
 BENCH_PHASE_TIMEOUT (per-phase watchdog deadline inside the child,
 default 600 s, 0 disables — a stuck phase emits a parseable JSON
 failure record naming the phase, its last-heartbeat age (stuck phase vs
@@ -175,10 +179,13 @@ def _run_bench() -> None:
     if os.environ.get("BENCH_MICRO") == "anchor_match":
         _run_anchor_match_micro()
         return
+    if os.environ.get("BENCH_MICRO") == "serve":
+        _run_serve_micro()
+        return
     if os.environ.get("BENCH_MICRO"):
         raise ValueError(
             f"unknown BENCH_MICRO mode {os.environ['BENCH_MICRO']!r} "
-            "(known: anchor_match)"
+            "(known: anchor_match, serve)"
         )
     import numpy as np
     import jax
@@ -474,6 +481,169 @@ def _run_anchor_match_micro() -> None:
                     "B": b, "A": a, "D": d, "iters": iters,
                     "dtype": str(jnp.dtype(dtype)),
                     "fused_backend": fused_backend,
+                },
+            }
+        )
+    )
+
+
+def _run_serve_micro() -> None:
+    """BENCH_MICRO=serve: latency/throughput of the online scoring
+    service (docs/serving.md).
+
+    Closed-loop load: BENCH_MICRO_CLIENTS in-process client threads each
+    score their share of BENCH_MICRO_REQUESTS mixed-length reports
+    through the micro-batcher (deadlines disabled — this measures the
+    service, not the shed path) and record end-to-end latencies.  One
+    JSON line reports requests/sec plus the latency percentiles an SLO
+    would be written against.  BENCH_MODEL=tiny exercises the full path
+    off-TPU in seconds; the recorded number is only meaningful at base
+    geometry on hardware.
+    """
+    import queue as _queue
+
+    import numpy as np
+    import jax
+
+    from memvul_tpu.utils.platform import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+    import jax.numpy as jnp
+
+    from memvul_tpu.data.readers import MemoryReader
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+    from memvul_tpu.models import BertConfig, MemoryModel
+    from memvul_tpu.serving import InprocessClient, ScoringService, ServiceConfig
+
+    watchdog = _watchdog()
+    n_requests = int(os.environ.get("BENCH_MICRO_REQUESTS", "2048"))
+    n_clients = int(os.environ.get("BENCH_MICRO_CLIENTS", "8"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "16"))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "5"))
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
+    n_anchors = 129
+
+    with watchdog.phase("workspace"):
+        ws = build_workspace(
+            tempfile.mkdtemp(), seed=0, num_projects=8,
+            reports_per_project=64, realistic_lengths=True,
+        )
+    if os.environ.get("BENCH_MODEL", "base") == "tiny":
+        cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+        seq_len = min(seq_len, cfg.max_position_embeddings)
+    else:
+        cfg = BertConfig.base(
+            vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
+        )
+    buckets = tuple(
+        b for b in (64, 128, 256, 512) if b <= seq_len
+    ) or (seq_len,)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    with watchdog.phase("model_init"):
+        params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    texts = [
+        inst["text1"] for inst in reader.read(ws["paths"]["test"], split="test")
+    ]
+    while len(texts) < n_requests:
+        texts = texts + texts
+    texts = texts[:n_requests]
+
+    predictor = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=max_batch, max_length=seq_len, buckets=buckets,
+    )
+    base_anchors = list(ws["anchors"].items())
+    anchor_instances = [
+        {
+            "text1": base_anchors[i % len(base_anchors)][1],
+            "meta": {"label": f"{base_anchors[i % len(base_anchors)][0]}#{i}",
+                     "type": "golden"},
+        }
+        for i in range(n_anchors)
+    ]
+    with watchdog.phase("anchor_encode"):
+        predictor.encode_anchors(anchor_instances)
+
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue=max(256, 2 * n_clients * max_batch),
+            default_deadline_ms=0.0,  # measure latency, don't shed it
+        ),
+    )
+    client = InprocessClient(service)
+    work: "_queue.SimpleQueue" = _queue.SimpleQueue()
+    for text in texts:
+        work.put(text)
+    latencies: list = []
+    lat_lock = threading.Lock()
+    errors = [0]
+
+    def _client_loop():
+        own: list = []
+        while True:
+            try:
+                text = work.get_nowait()
+            except _queue.Empty:
+                break
+            t0 = time.perf_counter()
+            resp = client.score(text, deadline_ms=0)
+            own.append(time.perf_counter() - t0)
+            if resp["status"] != "ok":
+                errors[0] += 1
+        with lat_lock:
+            latencies.extend(own)
+
+    # warmup trickle so thread pools/allocator ramp isn't billed to the load
+    with watchdog.phase("serve_warmup"):
+        client.score(texts[0], deadline_ms=0)
+    with watchdog.phase("serve_load"):
+        threads = [
+            threading.Thread(target=_client_loop, daemon=True)
+            for _ in range(n_clients)
+        ]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+    service.drain()
+
+    lat_ms = np.sort(np.asarray(latencies)) * 1e3
+    pct = lambda q: round(float(np.percentile(lat_ms, q)), 3) if len(lat_ms) else None
+    print(
+        json.dumps(
+            {
+                "metric": "serve_microbench",
+                "value": round(n_requests / elapsed, 1),
+                "unit": "requests/sec",
+                "vs_baseline": 0.0,  # no serving baseline exists (BASELINE.md)
+                "latency_ms": {
+                    "p50": pct(50), "p95": pct(95), "p99": pct(99),
+                    "max": round(float(lat_ms[-1]), 3) if len(lat_ms) else None,
+                    "mean": round(float(lat_ms.mean()), 3) if len(lat_ms) else None,
+                },
+                "errors": errors[0],
+                "config": {
+                    "model": os.environ.get("BENCH_MODEL", "base"),
+                    "seq_len": seq_len,
+                    "buckets": list(buckets),
+                    "requests": n_requests,
+                    "clients": n_clients,
+                    "max_batch": max_batch,
+                    "max_wait_ms": max_wait_ms,
                 },
             }
         )

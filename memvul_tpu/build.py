@@ -306,6 +306,106 @@ def train_from_config(
     return result
 
 
+def serve_from_archive(
+    archive_path: Union[str, Path],
+    out_dir: Optional[Union[str, Path]] = None,
+    overrides: Optional[Union[str, Dict[str, Any]]] = None,
+    golden_file: Optional[Union[str, Path]] = None,
+    mesh=None,
+    use_mesh: bool = False,
+):
+    """Build a ready :class:`~memvul_tpu.serving.ScoringService` from a
+    model archive (docs/serving.md).
+
+    The archive's ``serving`` section (config.SERVING_DEFAULTS) sizes
+    the online predictor — ``max_batch`` is its batch shape, so the AOT
+    warmup precompiles exactly the shapes the micro-batcher will
+    dispatch — and the service's admission-control envelope.  With
+    ``out_dir`` set, telemetry sinks and the versioned anchor-bank
+    manifest land there; the caller owns the registry's ``close()``
+    (the CLI closes it after the drain)."""
+    from . import telemetry
+    from .archive import load_archive
+    from .config import serving_config, telemetry_config
+    from .data.batching import validate_buckets
+    from .evaluate.predict_memory import SiamesePredictor
+    from .resilience.retry import RetryPolicy
+    from .serving import ScoringService, ServiceConfig
+
+    arch = load_archive(archive_path, overrides=overrides)
+    model_cfg = arch.config.get("model") or {}
+    model_type = model_cfg.get("type", "model_memory")
+    if model_type != "model_memory":
+        raise ValueError(
+            f"serving wraps the Siamese memory model; archive has "
+            f"model type {model_type!r}"
+        )
+    tel_cfg = telemetry_config(arch.config)
+    if out_dir is not None:
+        out_dir = Path(out_dir)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        telemetry.configure(
+            run_dir=out_dir,
+            enabled=bool(tel_cfg["enabled"]),
+            events=bool(tel_cfg["events"]),
+            heartbeat_every_s=float(tel_cfg["heartbeat_every_s"]),
+            step_events=bool(tel_cfg["step_events"]),
+        )
+    serve_cfg = serving_config(arch.config)
+    max_length = int(serve_cfg["max_length"])
+    model_positions = getattr(
+        getattr(arch.model, "config", None), "max_position_embeddings", None
+    )
+    if model_positions is not None and max_length > model_positions:
+        logger.warning(
+            "serving max_length %d exceeds the archived model's "
+            "max_position_embeddings %d — clamping",
+            max_length, model_positions,
+        )
+        max_length = model_positions
+    buckets = serve_cfg["buckets"]
+    if buckets == "auto":
+        raise ValueError(
+            'serving.buckets "auto" is an offline policy (it samples a '
+            "corpus); pass an explicit bucket list for serving"
+        )
+    if buckets is not None:
+        buckets = validate_buckets([int(b) for b in buckets], max_length)
+    if mesh is None and use_mesh and len(jax.devices()) > 1:
+        from .parallel.mesh import create_mesh
+
+        mesh = create_mesh()
+    predictor = SiamesePredictor(
+        arch.model,
+        arch.params,
+        arch.tokenizer,
+        mesh=mesh,
+        batch_size=int(serve_cfg["max_batch"]),
+        max_length=max_length,
+        buckets=buckets,
+        aot_warmup=True,  # the whole point: no mid-serve compiles
+    )
+    reader = build_reader(arch.config.get("dataset_reader"))
+    golden = golden_file or (
+        arch.config.get("dataset_reader") or {}
+    ).get("anchor_path")
+    if golden is None:
+        raise ValueError("serving needs a golden anchor file")
+    predictor.encode_anchors(reader.read_anchors(str(golden)))
+    retries = int(serve_cfg["retries"])
+    return ScoringService(
+        predictor,
+        config=ServiceConfig(
+            max_batch=int(serve_cfg["max_batch"]),
+            max_wait_ms=float(serve_cfg["max_wait_ms"]),
+            max_queue=int(serve_cfg["max_queue"]),
+            default_deadline_ms=float(serve_cfg["default_deadline_ms"]),
+        ),
+        retry_policy=RetryPolicy(attempts=retries) if retries > 0 else None,
+        manifest_dir=out_dir,
+    )
+
+
 def _auto_buckets_for_corpus(
     reader, tokenizer, test_path, max_length: int, n_buckets: int = 8,
     sample: int = 2048,

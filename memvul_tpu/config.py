@@ -272,6 +272,30 @@ def evaluation_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     return _section_over_defaults(cfg, "evaluation", EVALUATION_DEFAULTS)
 
 
+# The ``serving`` config section (docs/serving.md).  Read by
+# build.serve_from_archive, which sizes the online predictor (the
+# micro-batch IS its batch shape set, so ``max_batch``/``buckets`` here
+# decide which programs the AOT warmup precompiles) and the service's
+# admission-control envelope.
+SERVING_DEFAULTS: Dict[str, Any] = {
+    "max_batch": 16,         # requests coalesced per micro-batch flush
+    "max_wait_ms": 5.0,      # oldest-request coalescing window
+    "max_queue": 256,        # bounded queue depth; overflow sheds oldest
+    "default_deadline_ms": 2000.0,  # per-request budget (<=0 disables)
+    "retries": 2,            # transient batch retry attempts (0 = off)
+    "max_length": 512,       # token cap (clamped to the model's positions)
+    "buckets": None,         # explicit length buckets ("auto" needs a
+                             # corpus and is an offline-only policy)
+    "host": "127.0.0.1",     # HTTP front-end bind address
+    "port": 8341,            # HTTP front-end port
+}
+
+
+def serving_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``cfg["serving"]`` merged over :data:`SERVING_DEFAULTS`."""
+    return _section_over_defaults(cfg, "serving", SERVING_DEFAULTS)
+
+
 # The ``telemetry`` config section (docs/observability.md).  Read by the
 # build entry points, which configure the process-wide registry
 # (memvul_tpu.telemetry) with the run's serialization/output dir before

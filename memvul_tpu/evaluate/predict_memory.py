@@ -148,8 +148,29 @@ class SiamesePredictor:
             self._encode_anchors(anchor_instances)
 
     def _encode_anchors(self, anchor_instances: Iterable[Dict]) -> None:
+        bank, labels, n_anchors = self.encode_bank(anchor_instances)
+        self.anchor_bank = bank
+        self.anchor_labels = labels
+        self.n_anchors = n_anchors
+        n_model = self.mesh.shape.get(MODEL_AXIS, 1) if self.mesh is not None else 1
+        logger.info(
+            "anchor bank: %d anchors (%d padded), dim %d, model-sharding ×%d",
+            n_anchors, bank.shape[0] - n_anchors, bank.shape[1], n_model,
+        )
+        if self.aot_warmup:
+            self.warmup_compile()
+
+    def encode_bank(
+        self, anchor_instances: Iterable[Dict]
+    ) -> Tuple[jax.Array, List[str], int]:
+        """Encode an anchor set into a device-resident bank WITHOUT
+        installing it — the serving hot-swap path builds the replacement
+        bank here while the old one keeps serving, then installs its own
+        versioned snapshot (serving/service.py:swap_bank).  Returns
+        ``(bank, labels, n_real)``; the bank includes any model-sharding
+        padding rows, ``n_real`` is the unpadded anchor count."""
         instances = list(anchor_instances)
-        self.anchor_labels = [inst["meta"]["label"] for inst in instances]
+        labels = [inst["meta"]["label"] for inst in instances]
         chunks: List[np.ndarray] = []
         for start in range(0, len(instances), self.anchor_chunk):
             chunk = instances[start : start + self.anchor_chunk]
@@ -170,7 +191,7 @@ class SiamesePredictor:
             embeddings = np.asarray(self._encode_fn(self.params, batch))
             chunks.append(embeddings[: len(chunk)])
         bank = np.concatenate(chunks, axis=0)
-        self.n_anchors = bank.shape[0]
+        n_anchors = bank.shape[0]
         n_model = self.mesh.shape.get(MODEL_AXIS, 1) if self.mesh is not None else 1
         if n_model > 1:
             # CWE-1000 stretch: shard the anchor axis over "model" so the
@@ -180,24 +201,19 @@ class SiamesePredictor:
             # scores are sliced off before anything downstream sees them
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            pad = (-self.n_anchors) % n_model
+            pad = (-n_anchors) % n_model
             if pad:
                 bank = np.concatenate(
                     [bank, np.zeros((pad, bank.shape[1]), bank.dtype)], axis=0
                 )
-            self.anchor_bank = jax.device_put(
+            device_bank = jax.device_put(
                 bank, NamedSharding(self.mesh, P(MODEL_AXIS, None))
             )
         elif self.mesh is not None:
-            self.anchor_bank = replicate(bank, self.mesh)
+            device_bank = replicate(bank, self.mesh)
         else:
-            self.anchor_bank = jax.device_put(bank)
-        logger.info(
-            "anchor bank: %d anchors (%d padded), dim %d, model-sharding ×%d",
-            self.n_anchors, bank.shape[0] - self.n_anchors, bank.shape[1], n_model,
-        )
-        if self.aot_warmup:
-            self.warmup_compile()
+            device_bank = jax.device_put(bank)
+        return device_bank, labels, n_anchors
 
     # -- phase 1.5: AOT shape warmup -----------------------------------------
 
@@ -226,6 +242,13 @@ class SiamesePredictor:
         """
         if self.anchor_bank is None:
             raise RuntimeError("call encode_anchors() first")
+        return self.warmup_bank_shapes(self.anchor_bank)
+
+    def warmup_bank_shapes(self, bank) -> int:
+        """:meth:`warmup_compile` against an explicit bank array — the
+        serving hot-swap path warms a *replacement* bank's shapes here
+        before installing it, so a bank of a new geometry still never
+        costs a mid-serve compile (docs/serving.md)."""
         shapes = self.stream_shapes()
         start = time.perf_counter()
         tel = get_registry()
@@ -239,16 +262,14 @@ class SiamesePredictor:
                 if self.mesh is not None:
                     sample = shard_batch(sample, self.mesh)
                 try:
-                    self._score_fn.lower(
-                        self.params, sample, self.anchor_bank
-                    ).compile()
+                    self._score_fn.lower(self.params, sample, bank).compile()
                 except Exception as e:
                     if not self._maybe_degrade_to_xla(e):
                         raise
                     # the rebuilt program invalidates any shapes already
                     # compiled on the fused one — restart the warmup so
                     # the zero-mid-stream-compile contract still holds
-                    return self.warmup_compile()
+                    return self.warmup_bank_shapes(bank)
         logger.info(
             "AOT warmup: %d score program(s) %s compiled in %.1fs",
             len(shapes), shapes, time.perf_counter() - start,

@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import logging
 import time
+import weakref
 from pathlib import Path
 from typing import Dict, Iterable, List, Optional, Sequence, Union
 
@@ -36,6 +37,45 @@ logger = logging.getLogger(__name__)
 POS_INDEX = LABELS_BINARY["pos"]
 
 
+class _ProbsProgram:
+    """One jitted softmax-probs program per model, shared across
+    predictor instances.
+
+    Historically every ``SinglePredictor`` jitted a fresh lambda, so
+    each ``test_single`` call — and every one-off single-IR score —
+    cold-compiled its own executable even for an identical model.  jit
+    caches executables *on the function object*; keying the function by
+    model (linen modules hash by configuration) makes the second
+    predictor over the same model compile-free, the same warmed-program
+    contract the scoring service leans on (docs/serving.md).
+    ``trace_count`` mirrors ``SiamesePredictor.score_trace_count``: it
+    moves only when jit misses its cache and re-traces."""
+
+    def __init__(self, model) -> None:
+        self.trace_count = 0
+
+        def _probs(p, b):
+            self.trace_count += 1  # host-side, runs at trace only
+            return jax.nn.softmax(
+                model.apply(p, b, deterministic=True).astype(np.float32), axis=-1
+            )
+
+        self.fn = jax.jit(_probs)
+
+
+# weak keys: a program (and its compiled executables) lives exactly as
+# long as some caller still holds the model it was traced for
+_PROBS_PROGRAMS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def probs_program(model) -> _ProbsProgram:
+    """The shared per-model probs program (see :class:`_ProbsProgram`)."""
+    program = _PROBS_PROGRAMS.get(model)
+    if program is None:
+        program = _PROBS_PROGRAMS[model] = _ProbsProgram(model)
+    return program
+
+
 class SinglePredictor:
     def __init__(
         self,
@@ -47,6 +87,7 @@ class SinglePredictor:
         max_length: int = 512,
         buckets: Optional[Sequence[int]] = None,
         tokens_per_batch: Optional[int] = None,
+        aot_warmup: bool = True,
     ) -> None:
         self.model = model
         self.mesh = mesh
@@ -61,11 +102,40 @@ class SinglePredictor:
         else:
             self.bucket_sizes = None
         self.params = replicate(params, mesh) if mesh is not None else params
-        self._probs_fn = jax.jit(
-            lambda p, b: jax.nn.softmax(
-                self.model.apply(p, b, deterministic=True).astype(np.float32), axis=-1
-            )
-        )
+        self._program = probs_program(model)
+        self._probs_fn = self._program.fn
+        if aot_warmup:
+            self.warmup_compile()
+
+    @property
+    def score_trace_count(self) -> int:
+        """Traces of the shared probs program (cumulative across every
+        predictor over this model — the sharing is the point)."""
+        return self._program.trace_count
+
+    def stream_shapes(self) -> List[tuple]:
+        """The closed (rows, seq_len) set streaming can produce (the
+        same contract as ``SiamesePredictor.stream_shapes``)."""
+        if self.buckets is None:
+            return [(self.batch_size, self.encoder.max_length)]
+        sizes = self.bucket_sizes or {b: self.batch_size for b in self.buckets}
+        return [(sizes[b], b) for b in self.buckets]
+
+    def warmup_compile(self) -> int:
+        """AOT-precompile the probs program for every stream shape, so a
+        one-off score after startup never pays a compile (the shapes are
+        in the shared program's jit cache; a later predictor over the
+        same model skips even this warmup)."""
+        shapes = self.stream_shapes()
+        for rows, length in shapes:
+            sample = {
+                "input_ids": np.zeros((rows, length), np.int32),
+                "attention_mask": np.ones((rows, length), np.int32),
+            }
+            if self.mesh is not None:
+                sample = shard_batch(sample, self.mesh)
+            self._probs_fn.lower(self.params, sample).compile()
+        return len(shapes)
 
     def predict_file(
         self,

@@ -11,6 +11,8 @@ point              fires
                    written but before ``os.replace`` commits it (the torn-
                    write window)
 ``score.batch``    once per scoring batch, at dispatch
+``serve.batch``    once per serving micro-batch, at dispatch (inside the
+                   service's RetryPolicy window, serving/service.py)
 ``step.N``         at the start of optimizer step ``N`` (global step index)
 ``kernel.lower``   when the fused Pallas anchor-match kernel is selected,
                    before it is traced (simulates a Mosaic lowering failure)

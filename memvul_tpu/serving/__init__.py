@@ -1,0 +1,21 @@
+"""Online scoring service: dynamic micro-batching over AOT-warmed
+shapes, admission control with per-request deadlines, graceful drain,
+and hot anchor-bank swap (docs/serving.md).
+
+Entry points: ``build.serve_from_archive`` constructs a ready
+:class:`ScoringService` from a model archive; ``python -m memvul_tpu
+serve`` puts the stdlib HTTP front end (serving/frontend.py) on top.
+"""
+
+from .service import (  # noqa: F401
+    MANIFEST_NAME,
+    STATUS_DEADLINE,
+    STATUS_DRAIN,
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_SHED,
+    ScoreFuture,
+    ScoringService,
+    ServiceConfig,
+)
+from .client import HTTPClient, InprocessClient  # noqa: F401

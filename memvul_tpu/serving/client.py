@@ -1,0 +1,75 @@
+"""Clients for the scoring service.
+
+:class:`InprocessClient` is the synchronous wrapper tests and the
+``BENCH_MICRO=serve`` microbench drive — submit + block on the future,
+no sockets.  :class:`HTTPClient` is its stdlib-``urllib`` twin for the
+``http.server`` front end; both return the same response dicts
+(docs/serving.md), so a test written against one runs against the
+other.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+from .service import ScoringService
+
+
+class InprocessClient:
+    """Synchronous in-process client: one ``score`` call = submit + wait."""
+
+    def __init__(self, service: ScoringService) -> None:
+        self.service = service
+
+    def score(
+        self,
+        text: str,
+        deadline_ms: Optional[float] = None,
+        timeout_s: Optional[float] = 60.0,
+    ) -> Dict[str, Any]:
+        return self.service.submit(text, deadline_ms=deadline_ms).result(
+            timeout=timeout_s
+        )
+
+
+class HTTPClient:
+    """Minimal stdlib client for the JSON front end (serving/frontend.py).
+
+    Non-2xx responses still carry the service's JSON body (shed/
+    deadline/error statuses ride HTTP 5xx), so ``score`` parses and
+    returns it instead of raising — status handling stays in one place
+    for both client types.
+    """
+
+    def __init__(self, base_url: str, timeout_s: float = 60.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(self, req: urllib.request.Request) -> Dict[str, Any]:
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read().decode("utf-8"))
+
+    def score(
+        self, text: str, deadline_ms: Optional[float] = None
+    ) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {"text": text}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = deadline_ms
+        req = urllib.request.Request(
+            self.base_url + "/score",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        return self._request(req)
+
+    def health(self) -> Dict[str, Any]:
+        return self._request(
+            urllib.request.Request(self.base_url + "/healthz", method="GET")
+        )

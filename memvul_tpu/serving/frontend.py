@@ -1,0 +1,157 @@
+"""Stdlib-only HTTP front end for the scoring service.
+
+One hard rule, enforced by ``tools/lint_no_blocking_in_handler.py``:
+handler threads may only **enqueue** a request and **wait on its
+future**.  Tokenization, batching, and every device dispatch live on
+the service's batcher thread — a handler that scored inline would
+serialize the whole server behind one connection and reintroduce the
+per-request-shape compiles the micro-batcher exists to prevent.
+
+API (JSON over ``http.server``; docs/serving.md):
+
+* ``POST /score`` with ``{"text": "...", "deadline_ms": 500}`` →
+  the service response (``status`` "ok" carries the per-anchor
+  ``predict`` dict, best ``score``/``anchor``, and ``bank_version``).
+  HTTP status: 200 ok, 503 shed/drain, 504 deadline, 500 error.
+* ``GET /healthz`` → liveness + queue depth + bank version (200, or
+  503 once draining — a load balancer's eviction signal).
+
+The access log goes through ``logging`` (never print — the bare-print
+lint holds for serving code too).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .service import (
+    STATUS_DEADLINE,
+    STATUS_DRAIN,
+    STATUS_ERROR,
+    STATUS_OK,
+    STATUS_SHED,
+    ScoringService,
+)
+
+logger = logging.getLogger(__name__)
+
+_HTTP_STATUS = {
+    STATUS_OK: 200,
+    STATUS_SHED: 503,
+    STATUS_DRAIN: 503,
+    STATUS_DEADLINE: 504,
+    STATUS_ERROR: 500,
+}
+# client-visible slack past the request deadline before the handler
+# gives up waiting on the future (the service resolves deadline sheds
+# only at batch-pull time, so the wait must outlive the deadline)
+_RESULT_SLACK_S = 30.0
+
+
+class ScoringHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer carrying the service handle for handlers."""
+
+    daemon_threads = True
+
+    def __init__(self, address, service: ScoringService):
+        super().__init__(address, ScoreHandler)
+        self.service = service
+
+
+class ScoreHandler(BaseHTTPRequestHandler):
+    server_version = "memvul-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing --------------------------------------------------------------
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        # route the access log through logging: the CLI's stdout is a
+        # one-JSON-line contract and stderr belongs to the log handler
+        logger.info("%s %s", self.address_string(), format % args)
+
+    def _reply(self, http_status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(http_status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes ----------------------------------------------------------------
+
+    def do_GET(self) -> None:
+        if self.path != "/healthz":
+            self._reply(404, {"status": "error", "reason": "unknown path"})
+            return
+        service = self.server.service
+        draining = service._draining.is_set()
+        self._reply(503 if draining else 200, {
+            "status": "draining" if draining else "ok",
+            "queue_depth": service.queue_depth,
+            "bank_version": service.bank_version,
+        })
+
+    def do_POST(self) -> None:
+        if self.path != "/score":
+            self._reply(404, {"status": "error", "reason": "unknown path"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            text = payload["text"]
+            if not isinstance(text, str):
+                raise TypeError("'text' must be a string")
+            deadline_ms = payload.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {
+                "status": "error",
+                "reason": f"bad request: {type(e).__name__}: {e}",
+            })
+            return
+        service = self.server.service
+        # enqueue + wait on the future — the ONLY service interaction a
+        # handler is allowed (lint_no_blocking_in_handler)
+        future = service.submit(text, deadline_ms=deadline_ms)
+        wait_s = _RESULT_SLACK_S + (
+            deadline_ms / 1000.0
+            if deadline_ms and deadline_ms > 0
+            else service.config.default_deadline_ms / 1000.0
+        )
+        try:
+            response = future.result(timeout=wait_s)
+        except TimeoutError:
+            self._reply(504, {
+                "status": "error",
+                "reason": "request not resolved within the handler wait",
+            })
+            return
+        self._reply(_HTTP_STATUS.get(response["status"], 500), response)
+
+
+def run_http_server(
+    service: ScoringService,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    in_thread: bool = True,
+) -> ScoringHTTPServer:
+    """Bind and start serving (port 0 = ephemeral; read the bound port
+    off ``server.server_address``).  With ``in_thread`` the accept loop
+    runs on a daemon thread and the server handle is returned
+    immediately — call ``server.shutdown()`` then ``service.drain()``
+    to stop."""
+    server = ScoringHTTPServer((host, port), service)
+    if in_thread:
+        thread = threading.Thread(
+            target=server.serve_forever, name="memvul-serve-http", daemon=True
+        )
+        thread.start()
+    logger.info(
+        "scoring service listening on http://%s:%d (POST /score, GET /healthz)",
+        *server.server_address[:2],
+    )
+    return server

@@ -1,0 +1,544 @@
+"""The in-process online scoring service (docs/serving.md).
+
+The offline path (`evaluate/predict_memory.py`) streams a corpus it can
+see end-to-end; a service sees one report at a time and must answer in
+milliseconds.  The whole design problem is reconciling that with the
+shape discipline the TPU demands: XLA compiles one program per input
+shape, so the server may only ever dispatch the exact (rows, seq_len)
+shapes :meth:`SiamesePredictor.warmup_compile` precompiled at startup —
+a mid-serve compile is a multi-second latency cliff for every queued
+request behind it (asserted in tests via the ``score_trace_count``
+probe).
+
+Three cooperating pieces:
+
+* **dynamic micro-batcher** — requests land in a bounded deque; a
+  single batcher thread coalesces them until ``max_batch`` requests are
+  pulled or the oldest has waited ``max_wait_ms``, routes each request
+  to the smallest warmed length bucket covering its token count, and
+  pads every micro-batch to the warmed (rows, bucket) shape with the
+  same ``_pad_block`` the offline collator uses — so a served score is
+  bitwise-identical to the offline score of the same text;
+* **admission control** — the queue is bounded (``max_queue``); on
+  overflow the *oldest* queued request is shed (it is the one most
+  likely to miss its deadline anyway) with status ``"shed"`` instead of
+  letting latency grow without bound, and every request carries a
+  deadline after which it resolves ``"deadline"`` rather than dispatch;
+* **hot anchor-bank swap** — the bank is an immutable versioned
+  snapshot; a swap encodes the new bank off the request path, AOT-warms
+  the score program if the bank shape changed, then atomically installs
+  the new snapshot.  Each micro-batch captures exactly one snapshot, so
+  a response is never a torn mix of two banks.
+
+Shutdown mirrors the PR-2 preemption contract: SIGTERM finishes the
+in-flight micro-batch, resolves everything still queued with status
+``"drain"``, and leaves the telemetry sinks parseable.
+
+Failure routing: each micro-batch dispatch passes through the shared
+:class:`~memvul_tpu.resilience.retry.RetryPolicy` with the
+``serve.batch`` fault point inside the retried window; a persistent
+failure dead-letters the batch — every affected request resolves
+``"error"`` with the reason — instead of hanging its clients.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import json
+import logging
+import signal
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..data.batching import _pad_block
+from ..resilience import faults
+from ..resilience.retry import RetryPolicy, exception_text
+from ..telemetry import get_registry
+
+logger = logging.getLogger(__name__)
+
+# terminal request statuses (docs/serving.md, "Deadline semantics")
+STATUS_OK = "ok"            # scored; response carries the anchor probs
+STATUS_SHED = "shed"        # evicted by admission control (queue overflow)
+STATUS_DEADLINE = "deadline"  # deadline expired before dispatch
+STATUS_DRAIN = "drain"      # still queued when the service drained
+STATUS_ERROR = "error"      # batch dead-lettered after retries; see "reason"
+
+MANIFEST_NAME = "anchor_bank_manifest.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    """Knobs of the micro-batcher + admission control; defaults mirror
+    ``config.SERVING_DEFAULTS`` (the JSON-facing view)."""
+
+    max_batch: int = 16          # requests pulled per flush cycle
+    max_wait_ms: float = 5.0     # oldest-request coalescing window
+    max_queue: int = 256         # bounded queue depth (admission control)
+    default_deadline_ms: float = 2000.0  # per-request budget; <=0 = none
+
+
+class ScoreFuture:
+    """Resolved exactly once with a response dict; waiters block on an
+    event, never on the batcher's locks (the HTTP handler contract the
+    ``lint_no_blocking_in_handler`` tool enforces: enqueue + wait only)."""
+
+    __slots__ = ("_event", "_response", "_lock")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._response: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def resolve(self, response: Dict[str, Any]) -> bool:
+        """First resolution wins; later ones are ignored (a request has
+        exactly one owner at a time, this is belt-and-braces)."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._response = response
+            self._event.set()
+            return True
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("scoring request not resolved in time")
+        assert self._response is not None
+        return self._response
+
+
+@dataclasses.dataclass
+class _Request:
+    text: str
+    future: ScoreFuture
+    enqueued_monotonic: float
+    deadline_monotonic: Optional[float]  # None = no deadline
+
+
+@dataclasses.dataclass(frozen=True)
+class _BankVersion:
+    """One immutable anchor-bank snapshot.  ``array`` is the
+    device-resident (possibly sharding-padded) bank; ``n_anchors`` the
+    real row count; a micro-batch captures one snapshot and labels its
+    whole response from it — the no-torn-mix guarantee."""
+
+    version: int
+    array: Any
+    labels: Tuple[str, ...]
+    n_anchors: int
+
+
+class ScoringService:
+    """Micro-batching scorer over a warmed :class:`SiamesePredictor`.
+
+    The predictor must already have its anchor bank encoded (that run
+    included the AOT shape warmup); the service never triggers a compile
+    on the request path.  ``manifest_dir`` (usually the telemetry run
+    dir) receives the versioned ``anchor_bank_manifest.json`` through
+    ``atomic_write_text`` on startup and after every swap.
+    """
+
+    def __init__(
+        self,
+        predictor,
+        config: Optional[ServiceConfig] = None,
+        retry_policy: Optional[RetryPolicy] = None,
+        manifest_dir: Optional[Union[str, Path]] = None,
+    ) -> None:
+        if getattr(predictor, "anchor_bank", None) is None:
+            raise RuntimeError(
+                "predictor has no anchor bank — call encode_anchors() "
+                "(with aot_warmup) before constructing the service"
+            )
+        self.predictor = predictor
+        self.config = config or ServiceConfig()
+        self.retry_policy = retry_policy
+        self.manifest_dir = Path(manifest_dir) if manifest_dir else None
+        # warmed shape set: bucket length → padded row count.  Dispatch
+        # may ONLY use these shapes (the zero-mid-serve-compile contract).
+        self._rows_by_length: Dict[int, int] = {
+            length: rows for rows, length in predictor.stream_shapes()
+        }
+        self._lengths = sorted(self._rows_by_length)
+        self._bank = _BankVersion(
+            version=1,
+            array=predictor.anchor_bank,
+            labels=tuple(predictor.anchor_labels),
+            n_anchors=predictor.n_anchors,
+        )
+        self._bank_lock = threading.Lock()
+        self._swap_lock = threading.Lock()  # one swap at a time
+        self._queue: "collections.deque[_Request]" = collections.deque()
+        self._cond = threading.Condition()
+        # drain is signalled via a bare Event (no lock acquisition) so
+        # the SIGTERM handler can run even while the main thread holds
+        # the queue condition — same non-reentrancy hazard the trainer's
+        # preemption handler avoids by only setting a flag
+        self._draining = threading.Event()
+        self._closed = threading.Event()
+        self._tel = get_registry()
+        self._write_manifest()
+        self._thread = threading.Thread(
+            target=self._loop, name="memvul-serve-batcher", daemon=True
+        )
+        self._thread.start()
+
+    # -- submission (any thread) ----------------------------------------------
+
+    def submit(
+        self, text: str, deadline_ms: Optional[float] = None
+    ) -> ScoreFuture:
+        """Enqueue one report text; returns immediately with a future.
+
+        Admission control happens here: during drain the request is
+        refused with ``"drain"``; on queue overflow the *oldest* queued
+        request is shed with ``"shed"`` to make room (FIFO eviction —
+        the newest request has the freshest deadline)."""
+        future = ScoreFuture()
+        now = time.monotonic()
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline = now + deadline_ms / 1000.0 if deadline_ms > 0 else None
+        request = _Request(
+            text=text, future=future,
+            enqueued_monotonic=now, deadline_monotonic=deadline,
+        )
+        self._tel.counter("serve.requests").inc()
+        if self._draining.is_set():
+            self._finish_unserved(request, STATUS_DRAIN)
+            return future
+        shed: Optional[_Request] = None
+        with self._cond:
+            if len(self._queue) >= self.config.max_queue:
+                shed = self._queue.popleft()
+            self._queue.append(request)
+            self._tel.gauge("serve.queue_depth").set(len(self._queue))
+            self._cond.notify()
+        if shed is not None:
+            self._finish_unserved(shed, STATUS_SHED)
+        return future
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    @property
+    def bank_version(self) -> int:
+        with self._bank_lock:
+            return self._bank.version
+
+    @property
+    def bank_labels(self) -> Tuple[str, ...]:
+        with self._bank_lock:
+            return self._bank.labels
+
+    # -- shutdown --------------------------------------------------------------
+
+    def request_drain(self) -> None:
+        """Begin graceful shutdown (async-signal-safe: sets a flag, takes
+        no lock).  The batcher finishes the micro-batch it already
+        pulled, resolves everything still queued with ``"drain"``, and
+        exits; :meth:`drain` waits for that."""
+        self._draining.set()
+
+    def drain(self, timeout: Optional[float] = 30.0) -> None:
+        """Graceful shutdown: drain in-flight work, shed the queue with
+        the drain status, stop the batcher.  Idempotent."""
+        self.request_drain()
+        self._thread.join(timeout)
+        if self._thread.is_alive():  # pragma: no cover - defensive
+            logger.warning("serve batcher did not exit within %ss", timeout)
+        self._closed.set()
+
+    close = drain
+
+    def install_signal_handlers(self) -> List[Tuple[int, Any]]:
+        """SIGTERM (the managed-pod preemption notice) and SIGINT begin
+        a graceful drain — the same finish-the-in-flight-step contract
+        the trainer's preemption handler keeps.  Returns the previous
+        handlers for :meth:`restore_signal_handlers`."""
+        previous: List[Tuple[int, Any]] = []
+
+        def _handler(signum, frame):  # runs in the main thread
+            logger.info("signal %s: draining scoring service", signum)
+            self.request_drain()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                previous.append((sig, signal.signal(sig, _handler)))
+            except ValueError:  # not the main thread (tests, embedding)
+                pass
+        return previous
+
+    @staticmethod
+    def restore_signal_handlers(previous: List[Tuple[int, Any]]) -> None:
+        for sig, handler in previous:
+            signal.signal(sig, handler)
+
+    # -- hot anchor-bank swap --------------------------------------------------
+
+    def swap_bank(self, anchor_instances: Iterable[Dict]) -> int:
+        """Re-encode a new anchor set and atomically install it.
+
+        Runs in the *caller's* thread (callers wrap it in a background
+        thread when they must not block): the encode and — if the padded
+        bank shape changed — an AOT re-warm of every stream shape happen
+        entirely before the swap, so the batcher never sees a shape it
+        has not compiled.  In-flight micro-batches keep the snapshot
+        they captured; the next batch picks up the new version.  Returns
+        the new version number."""
+        with self._swap_lock:
+            bank, labels, n_anchors = self.predictor.encode_bank(
+                anchor_instances
+            )
+            with self._bank_lock:
+                current = self._bank
+            if bank.shape != current.array.shape:
+                # new bank geometry = new XLA program per stream shape;
+                # compile them here, off the request path, so the swap
+                # still never costs a mid-serve compile
+                logger.info(
+                    "bank swap changes shape %s -> %s: re-warming %d "
+                    "stream shape(s) before install",
+                    tuple(current.array.shape), tuple(bank.shape),
+                    len(self._rows_by_length),
+                )
+                with self._tel.span("serve.bank_warmup"):
+                    self.predictor.warmup_bank_shapes(bank)
+            with self._bank_lock:
+                new = _BankVersion(
+                    version=current.version + 1,
+                    array=bank,
+                    labels=tuple(labels),
+                    n_anchors=n_anchors,
+                )
+                self._bank = new
+        self._tel.counter("serve.bank_swaps").inc()
+        self._tel.gauge("serve.bank_version").set(new.version)
+        self._tel.event(
+            "bank_swap", version=new.version, n_anchors=new.n_anchors
+        )
+        self._write_manifest()
+        logger.info(
+            "anchor bank v%d installed: %d anchors", new.version, new.n_anchors
+        )
+        return new.version
+
+    def _write_manifest(self) -> None:
+        """Versioned bank manifest beside the telemetry sinks, written
+        atomically so an operator (or a restarting supervisor) never
+        reads a torn view of which bank is live."""
+        if self.manifest_dir is None:
+            return
+        from ..resilience.io import atomic_write_text
+
+        with self._bank_lock:
+            bank = self._bank
+        digest = hashlib.sha256(
+            "\n".join(bank.labels).encode("utf-8")
+        ).hexdigest()
+        self.manifest_dir.mkdir(parents=True, exist_ok=True)
+        atomic_write_text(
+            self.manifest_dir / MANIFEST_NAME,
+            json.dumps(
+                {
+                    "version": bank.version,
+                    "n_anchors": bank.n_anchors,
+                    "labels_sha256": digest,
+                    "labels": list(bank.labels),
+                    "written_wall": time.time(),
+                },
+                indent=2,
+            ),
+        )
+
+    # -- batcher thread --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._draining.is_set():
+            pulled = self._pull_batch()
+            if pulled:
+                # a pull that completed before the drain flag was seen is
+                # the in-flight work — it finishes (the trainer's
+                # finish-the-step contract); everything still queued sheds
+                self._dispatch(pulled)
+                self._tel.heartbeat()
+        self._shed_queue(STATUS_DRAIN)
+        self._tel.event("serve_drained")
+        self._tel.heartbeat(force=True)
+
+    def _pull_batch(self) -> List[_Request]:
+        """Coalesce up to ``max_batch`` requests: wait for the first,
+        then keep pulling until the flush window (``max_wait_ms`` after
+        the pull started) closes or the batch is full.  Waits are short
+        so the drain flag — which is set without taking the condition —
+        is noticed promptly."""
+        cfg = self.config
+        pulled: List[_Request] = []
+        with self._cond:
+            while not self._queue:
+                if self._draining.is_set():
+                    return pulled
+                self._cond.wait(0.05)
+            pulled.append(self._queue.popleft())
+        flush_at = time.monotonic() + cfg.max_wait_ms / 1000.0
+        while len(pulled) < cfg.max_batch and not self._draining.is_set():
+            remaining = flush_at - time.monotonic()
+            if remaining <= 0:
+                break
+            with self._cond:
+                if not self._queue:
+                    self._cond.wait(min(remaining, 0.05))
+                if self._queue:
+                    pulled.append(self._queue.popleft())
+        with self._cond:
+            self._tel.gauge("serve.queue_depth").set(len(self._queue))
+        return pulled
+
+    def _dispatch(self, pulled: List[_Request]) -> None:
+        """Score one coalesced pull: expire stale requests, route the
+        rest to their warmed bucket shapes, resolve every future."""
+        now = time.monotonic()
+        live: List[_Request] = []
+        for request in pulled:
+            if (
+                request.deadline_monotonic is not None
+                and now > request.deadline_monotonic
+            ):
+                self._finish_unserved(request, STATUS_DEADLINE)
+            else:
+                live.append(request)
+        if not live:
+            return
+        with self._bank_lock:
+            bank = self._bank  # ONE snapshot for the whole pull
+        encoder = self.predictor.encoder
+        seqs = encoder.encode_many([r.text for r in live])
+        groups: Dict[int, List[Tuple[_Request, List[int]]]] = {}
+        for request, seq in zip(live, seqs):
+            groups.setdefault(self._bucket_for(len(seq)), []).append(
+                (request, seq)
+            )
+        for length in sorted(groups):
+            rows = self._rows_by_length[length]
+            group = groups[length]
+            for start in range(0, len(group), rows):
+                self._score_chunk(group[start : start + rows], length, rows, bank)
+
+    def _bucket_for(self, n_tokens: int) -> int:
+        """Smallest warmed bucket covering the token count (over-long
+        texts truncate into the largest bucket, matching the offline
+        collator's ``seq[:length]``)."""
+        for length in self._lengths:
+            if length >= n_tokens:
+                return length
+        return self._lengths[-1]
+
+    def _score_chunk(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        length: int,
+        rows: int,
+        bank: _BankVersion,
+    ) -> None:
+        """One device dispatch at a warmed (rows, length) shape.  The
+        ``serve.batch`` fault point fires inside the retried window;
+        retry exhaustion (or a non-transient failure) dead-letters the
+        chunk — every request resolves ``"error"`` with the reason —
+        rather than hanging its clients."""
+        from ..parallel.mesh import shard_batch
+
+        tel = self._tel
+        sample = _pad_block(
+            [seq for _, seq in chunk], rows, self.predictor.encoder.pad_id, length
+        )
+        if self.predictor.mesh is not None:
+            sample = shard_batch(sample, self.predictor.mesh)
+
+        def once():
+            faults.fault_point("serve.batch")
+            return self.predictor._score_fn(
+                self.predictor.params, sample, bank.array
+            )
+
+        start = time.perf_counter()
+        try:
+            if self.retry_policy is None:
+                dev = once()
+            else:
+                dev = self.retry_policy.call(once, description="serve batch")
+            probs = np.asarray(dev)[: len(chunk), : bank.n_anchors]
+        except Exception as e:
+            reason = exception_text(e)
+            logger.error(
+                "serve batch dead-lettered (%d request(s)): %s",
+                len(chunk), reason[:300],
+            )
+            tel.counter("serve.dead_letters").inc()
+            tel.counter("serve.errors").inc(len(chunk))
+            response = {"status": STATUS_ERROR, "reason": reason}
+            for request, _ in chunk:
+                request.future.resolve(dict(response))
+            return
+        tel.histogram("serve.batch_latency_s").observe(
+            time.perf_counter() - start
+        )
+        tel.histogram("serve.batch_occupancy").observe(len(chunk) / rows)
+        tel.counter("serve.batches").inc()
+        tel.counter("serve.served").inc(len(chunk))
+        tel.progress()
+        now = time.monotonic()
+        for (request, _), row in zip(chunk, probs):
+            best = int(np.argmax(row))
+            tel.histogram("serve.latency_s").observe(
+                now - request.enqueued_monotonic
+            )
+            request.future.resolve({
+                "status": STATUS_OK,
+                "predict": {
+                    label: float(p) for label, p in zip(bank.labels, row)
+                },
+                "score": float(row[best]),
+                "anchor": bank.labels[best],
+                "bank_version": bank.version,
+                "latency_ms": round(
+                    (now - request.enqueued_monotonic) * 1e3, 3
+                ),
+            })
+
+    # -- shed / drain resolution ----------------------------------------------
+
+    def _finish_unserved(self, request: _Request, status: str) -> None:
+        """Resolve a request that will never be scored.  ``serve.shed``
+        counts every load-management resolution (overflow + deadline +
+        drain) so ``serve.served + serve.shed + serve.errors`` always
+        sums to ``serve.requests``; the per-cause sub-counters are what
+        the shed/deadline tests pin exactly."""
+        sub = {
+            STATUS_SHED: "serve.shed_overflow",
+            STATUS_DEADLINE: "serve.shed_deadline",
+            STATUS_DRAIN: "serve.shed_drain",
+        }[status]
+        tel = self._tel
+        tel.counter("serve.shed").inc()
+        tel.counter(sub).inc()
+        request.future.resolve({"status": status})
+
+    def _shed_queue(self, status: str) -> None:
+        while True:
+            with self._cond:
+                if not self._queue:
+                    self._tel.gauge("serve.queue_depth").set(0)
+                    return
+                request = self._queue.popleft()
+            self._finish_unserved(request, status)

@@ -52,7 +52,13 @@ def _span_table(events: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
 
 
 def _fmt_s(v: Optional[float]) -> str:
-    return "-" if v is None else f"{v:.3f}s"
+    """Seconds for display; tolerates junk (a hand-edited or corrupted
+    sink value must degrade to "-", never crash the report — the report
+    is the post-mortem tool, it has no one to crash to)."""
+    try:
+        return f"{float(v):.3f}s"
+    except (TypeError, ValueError):
+        return "-"
 
 
 def _fmt_num(v: Any) -> str:
@@ -77,13 +83,35 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
            if data["events_skipped"] else "")
     )
     if not (events or summary or heartbeat):
-        lines.append("  (no telemetry sinks found in this directory)")
+        # distinguish "this dir never had telemetry" from "a run started
+        # but recorded nothing" (empty/blank sink files — e.g. a server
+        # that was killed before its first event, or telemetry started
+        # and immediately torn) — the operator's next step differs
+        sink_files = [
+            name for name in ("events.jsonl", "telemetry.json", "HEARTBEAT.json")
+            if (data["run_dir"] / name).exists()
+        ]
+        if sink_files:
+            lines.append(
+                "  no events recorded (empty sink file(s): "
+                + ", ".join(sink_files) + ")"
+            )
+        else:
+            lines.append("  (no telemetry sinks found in this directory)")
         return "\n".join(lines)
+    if not events:
+        # heartbeat-/summary-only dirs (a SIGKILL before the first event
+        # flush, or events disabled) still render the sections below —
+        # but say explicitly that the event stream is empty rather than
+        # silently omitting the phase table
+        lines.append("  no events recorded — phase table unavailable")
 
     # -- liveness -------------------------------------------------------------
     if heartbeat:
-        written = heartbeat.get("written_wall")
-        age = (now - float(written)) if written is not None else None
+        try:
+            age: Optional[float] = now - float(heartbeat.get("written_wall"))
+        except (TypeError, ValueError):
+            age = None
         lines.append("")
         lines.append("HEARTBEAT")
         lines.append(

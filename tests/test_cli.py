@@ -682,3 +682,37 @@ def test_cli_evaluate_with_int8_quant_override(ws, tmp_path):
     arch = load_archive(ser_dir, overrides=overrides)
     model = build_model(dict(arch.config["model"]), arch.tokenizer.vocab_size)
     assert model.config.quant == "int8_dynamic"
+
+
+def test_cli_help_names_every_registered_subcommand(capsys):
+    """The top-level --help is the CLI's table of contents: every
+    registered subcommand (including serve and telemetry-report) must
+    appear there with a one-line description — a new command cannot
+    ship invisible."""
+    import argparse
+
+    from memvul_tpu.__main__ import build_parser
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions
+        if isinstance(a, argparse._SubParsersAction)
+    )
+    names = set(sub.choices)
+    # the full current command surface; growing it here is deliberate
+    assert {
+        "train", "evaluate", "serve", "pretrain", "baseline", "build-data",
+        "analyze", "bench", "telemetry-report", "doctor", "parity",
+        "selfcheck",
+    } <= names
+    # every subcommand carries a non-empty one-line help
+    helps = {ca.dest: ca.help for ca in sub._choices_actions}
+    for name in names:
+        assert helps.get(name), f"subcommand {name!r} has no help text"
+    # and the rendered --help output names each of them
+    with pytest.raises(SystemExit) as exc:
+        main(["--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for name in names:
+        assert name in out, f"--help does not mention {name!r}"

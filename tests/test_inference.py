@@ -344,3 +344,50 @@ def test_single_predictor_bucket_token_budget(ws):
         buckets=(16, 32, 64), tokens_per_batch=512,
     )
     assert pred.bucket_sizes == {16: 32, 32: 16, 64: 8}
+
+
+def test_single_predictor_shares_warmed_probs_program(ws):
+    """predict_single's probs program is cached per model: a second
+    predictor over an equal model (the one-off single-IR scoring path)
+    adds ZERO traces — historically every call cold-compiled its own
+    jitted lambda.  Counts are deltas off the shared program's history:
+    earlier tests over an equal tiny model legitimately pre-warmed it
+    (that reuse IS the feature)."""
+    from memvul_tpu.evaluate.predict_single import SinglePredictor, probs_program
+
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = SingleModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy)
+    base = probs_program(model).trace_count
+    # an odd geometry no other test uses → its warmup traces exactly once
+    first = SinglePredictor(
+        model, params, ws["tokenizer"], batch_size=3, max_length=24,
+    )
+    assert first.score_trace_count == base + 1
+    reader = SingleReader()
+    out = Path(ws["paths"]["test"]).parent / "single_cache_result.json"
+    first.predict_file(reader, ws["paths"]["test"], out)
+    assert first.score_trace_count == base + 1  # streaming reused the warmup
+
+    # an EQUAL model (fresh object) and fresh params: same program, so
+    # construction + scoring is compile-free after startup
+    model2 = SingleModel(BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size))
+    params2 = model2.init(jax.random.PRNGKey(1), dummy)
+    second = SinglePredictor(
+        model2, params2, ws["tokenizer"], batch_size=3, max_length=24,
+    )
+    assert second.score_trace_count == base + 1  # no new trace
+    second.predict_file(reader, ws["paths"]["test"], out)
+    assert second.score_trace_count == base + 1
+
+    # adding a bucket set only compiles the genuinely NEW shape — the
+    # (3, 24) bucket hits the shared program's existing executable
+    other = SinglePredictor(
+        model2, params2, ws["tokenizer"], batch_size=3, max_length=24,
+        buckets=[16, 24],
+    )
+    assert other.score_trace_count == base + 2  # +1 for (3, 16) only

@@ -1,0 +1,62 @@
+"""Tier-1 invariant: HTTP handler classes only enqueue + wait on a
+future (tools/lint_no_blocking_in_handler.py) — a handler that sleeps
+or scores inline serializes the server behind one connection and can
+trigger mid-serve compiles (docs/serving.md)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint_no_blocking_in_handler import find_blocking_calls, main  # noqa: E402
+
+
+def test_package_handlers_are_non_blocking():
+    offenders = find_blocking_calls(REPO / "memvul_tpu")
+    assert offenders == [], (
+        "blocking call in an HTTP handler (handlers may only submit() "
+        f"and wait on the future, docs/serving.md): {offenders}"
+    )
+
+
+def test_lint_flags_planted_offenders(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        "import time\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self):\n"
+        "        time.sleep(1)\n"
+        "        self.server.service.predictor.predict_file('x')\n"
+        "        self.server.service.swap_bank([])\n"
+    )
+    (tmp_path / "ok.py").write_text(
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self):\n"
+        "        fut = self.server.service.submit('x')\n"
+        "        fut.result(timeout=1)\n"
+        "def free_function():\n"
+        "    import time\n"
+        "    time.sleep(1)  # outside a handler class: allowed\n"
+    )
+    offenders = find_blocking_calls(tmp_path)
+    assert len(offenders) == 3
+    assert all("bad.py" in o for o in offenders)
+    assert any(o.endswith("sleep") for o in offenders)
+    assert any(o.endswith("predict_file") for o in offenders)
+    assert any(o.endswith("swap_bank") for o in offenders)
+
+
+def test_lint_cli_exit_codes(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    assert main([str(tmp_path)]) == 0
+    (tmp_path / "bad.py").write_text(
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        sleep(1)\n"
+    )
+    assert main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py:3" in out
+    assert main([str(tmp_path / "missing")]) == 2

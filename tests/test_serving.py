@@ -1,0 +1,547 @@
+"""Online scoring service (memvul_tpu/serving/, docs/serving.md).
+
+The acceptance contract this file pins:
+
+* **correctness** — ≥200 concurrent mixed-length requests return
+  probabilities bitwise-equal to direct ``SiamesePredictor`` scoring of
+  the same texts, with zero mid-serve recompiles (``score_trace_count``
+  flat after warmup);
+* **shutdown** — SIGTERM mid-load finishes the in-flight micro-batch,
+  sheds everything queued with the ``"drain"`` status, and leaves a
+  parseable ``telemetry.json`` whose served+shed counters sum to the
+  submitted count;
+* **admission control** — a full queue sheds the *oldest* requests with
+  ``"shed"``, expired requests resolve ``"deadline"``, and the
+  telemetry sub-counters match the per-status response counts exactly
+  (driven by a slow fake predictor — no real model, no timing races);
+* **chaos** — a transient ``serve.batch`` fault retries through
+  ``RetryPolicy`` and still returns correct scores; a persistent one
+  dead-letters with a reason instead of hanging clients;
+* **hot swap** — swapping to a sentinel bank mid-stream never yields a
+  torn mix of old and new labels within one response, and the versioned
+  manifest commits atomically.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu import telemetry
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.resilience import faults
+from memvul_tpu.resilience.retry import RetryPolicy
+from memvul_tpu.serving import (
+    MANIFEST_NAME,
+    STATUS_DEADLINE,
+    STATUS_DRAIN,
+    STATUS_OK,
+    STATUS_SHED,
+    HTTPClient,
+    InprocessClient,
+    ScoringService,
+    ServiceConfig,
+)
+from memvul_tpu.serving.frontend import run_http_server
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("serving"), seed=7)
+
+
+@pytest.fixture(scope="module")
+def setup(ws):
+    """One warmed tiny predictor shared by the real-model tests (its
+    jit caches persist across tests — exactly the warmed-program reuse
+    the service relies on)."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    predictor = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=8, max_length=48, buckets=[16, 48],
+    )
+    predictor.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    texts = [
+        inst["text1"]
+        for inst in reader.read(ws["paths"]["test"], split="test")
+    ]
+    return predictor, reader, texts
+
+
+@pytest.fixture()
+def tel(tmp_path):
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    yield registry
+    telemetry.reset()
+    faults.reset()
+
+
+def make_service(predictor, tel_dir=None, **overrides):
+    defaults = dict(
+        max_batch=8, max_wait_ms=3.0, max_queue=1000,
+        default_deadline_ms=30000.0,
+    )
+    defaults.update(overrides)
+    return ScoringService(
+        predictor, config=ServiceConfig(**defaults), manifest_dir=tel_dir
+    )
+
+
+# -- end-to-end correctness ----------------------------------------------------
+
+def test_concurrent_mixed_length_requests_bitwise_match_direct(setup, tel):
+    """≥200 concurrent requests, all bitwise-equal to offline scoring,
+    zero mid-serve recompiles."""
+    predictor, _, texts = setup
+    n = 200
+    picks = [texts[i % len(texts)] for i in range(n)]
+    # direct scoring of the same texts through the SAME bucket policy
+    instances = [
+        {"text1": t, "label": "same", "meta": {"i": i}}
+        for i, t in enumerate(picks)
+    ]
+    expected = {}
+    for probs, metas in predictor.score_instances(iter(instances)):
+        for row, meta in zip(probs, metas):
+            expected[meta["i"]] = row.copy()
+    traces_before = predictor.score_trace_count
+
+    service = make_service(predictor)
+    client = InprocessClient(service)
+    results = {}
+    lock = threading.Lock()
+
+    def worker(indices):
+        for i in indices:
+            response = client.score(picks[i])
+            with lock:
+                results[i] = response
+
+    threads = [
+        threading.Thread(target=worker, args=(range(k, n, 16),))
+        for k in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    service.drain()
+
+    assert len(results) == n
+    for i in range(n):
+        assert results[i]["status"] == STATUS_OK
+        got = np.array(
+            [results[i]["predict"][label] for label in predictor.anchor_labels],
+            dtype=np.float32,
+        )
+        want = np.asarray(expected[i], dtype=np.float32)
+        np.testing.assert_array_equal(got, want)  # bitwise, not approx
+        assert results[i]["bank_version"] == 1
+    # the whole load ran on the AOT-warmed programs
+    assert predictor.score_trace_count == traces_before
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.served"] == n
+    assert counters["serve.requests"] == n
+
+
+def test_sigterm_mid_load_drains_and_telemetry_sums(setup, tel, tmp_path):
+    """SIGTERM finishes in-flight work, sheds the queue with "drain",
+    and telemetry.json parses with served+shed == submitted."""
+    predictor, _, texts = setup
+    service = make_service(predictor, max_batch=4)
+    previous = service.install_signal_handlers()
+    n = 200
+    try:
+        futures = [service.submit(texts[i % len(texts)]) for i in range(n)]
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        service.drain()
+    finally:
+        service.restore_signal_handlers(previous)
+    statuses = {}
+    for future in futures:
+        status = future.result(timeout=10)["status"]
+        statuses[status] = statuses.get(status, 0) + 1
+    assert set(statuses) <= {STATUS_OK, STATUS_DRAIN}
+    assert statuses.get(STATUS_DRAIN, 0) > 0  # the kill landed mid-load
+    run_dir = tel.run_dir
+    tel.close()
+    rollup = json.loads((run_dir / "telemetry.json").read_text())
+    counters = rollup["counters"]
+    assert counters["serve.served"] + counters["serve.shed"] == n
+    assert counters["serve.served"] == statuses.get(STATUS_OK, 0)
+    assert counters["serve.shed_drain"] == statuses.get(STATUS_DRAIN, 0)
+
+
+# -- admission control (slow fake predictor — no model, no races) --------------
+
+class _FakeEncoder:
+    pad_id = 0
+
+    def __init__(self, max_length=8):
+        self.max_length = max_length
+
+    def encode_many(self, texts):
+        return [[1] * min(len(t), self.max_length) for t in texts]
+
+
+class _SlowFakePredictor:
+    """Minimal predictor surface; scoring blocks until released, so the
+    tests control exactly when the batcher is busy."""
+
+    def __init__(self, n_anchors=3, rows=4, length=8):
+        self.encoder = _FakeEncoder(length)
+        self.mesh = None
+        self.params = None
+        self.n_anchors = n_anchors
+        self.anchor_labels = [f"A{i}" for i in range(n_anchors)]
+        self.anchor_bank = np.zeros((n_anchors, 2), np.float32)
+        self.score_trace_count = 0
+        self._shapes = [(rows, length)]
+        self.started = threading.Event()  # set when a batch enters scoring
+        self.hold = threading.Event()     # scoring blocks until set
+
+    def stream_shapes(self):
+        return list(self._shapes)
+
+    def _score_fn(self, params, sample, bank):
+        self.started.set()
+        assert self.hold.wait(timeout=10), "test forgot to release hold"
+        rows = sample["input_ids"].shape[0]
+        return np.tile(
+            np.linspace(0.1, 0.9, self.n_anchors, dtype=np.float32), (rows, 1)
+        )
+
+
+def test_queue_overflow_sheds_oldest_and_deadline_expires(tel):
+    """Queue fills → oldest shed with "shed"; waiting past the deadline
+    → "deadline"; sub-counters match the response counts exactly."""
+    fake = _SlowFakePredictor()
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=1.0, max_queue=4,
+            default_deadline_ms=50.0,
+        ),
+    )
+    # occupy the batcher: first request is pulled and blocks in scoring
+    first = service.submit("r0", deadline_ms=0)  # no deadline
+    assert fake.started.wait(timeout=5)
+    # burst 8 while busy: queue cap 4 → the 4 oldest of the burst shed
+    burst = [service.submit(f"r{i+1}", deadline_ms=50.0) for i in range(8)]
+    shed = [f for f in burst[:4]]
+    queued = [f for f in burst[4:]]
+    for future in shed:
+        assert future.result(timeout=5)["status"] == STATUS_SHED
+    # let the queued ones expire, then release the batcher
+    time.sleep(0.1)
+    fake.hold.set()
+    assert first.result(timeout=10)["status"] == STATUS_OK
+    for future in queued:
+        assert future.result(timeout=10)["status"] == STATUS_DEADLINE
+    service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.shed_overflow"] == 4   # exactly the shed set
+    assert counters["serve.shed_deadline"] == 4   # exactly the expired set
+    assert counters["serve.shed"] == 8
+    assert counters["serve.served"] == 1
+    assert counters["serve.requests"] == 9
+
+
+def test_submit_after_drain_resolves_drain_status(tel):
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    service = ScoringService(fake, config=ServiceConfig(max_wait_ms=1.0))
+    service.drain()
+    response = service.submit("late").result(timeout=5)
+    assert response["status"] == STATUS_DRAIN
+    assert tel.snapshot()["counters"]["serve.shed_drain"] == 1
+
+
+# -- chaos ---------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_transient_serve_batch_fault_retries_to_correct_scores(setup, tel):
+    predictor, _, texts = setup
+    # direct expectation before arming the fault
+    instances = [{"text1": texts[0], "label": "same", "meta": {"i": 0}}]
+    (expected, _), = predictor.score_instances(iter(instances))
+    faults.configure("serve.batch=raise:RuntimeError:UNAVAILABLE injected")
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(max_batch=8, max_wait_ms=3.0),
+        retry_policy=RetryPolicy(attempts=3, sleep=lambda s: None),
+    )
+    response = InprocessClient(service).score(texts[0])
+    service.drain()
+    assert response["status"] == STATUS_OK
+    got = np.array(
+        [response["predict"][label] for label in predictor.anchor_labels],
+        dtype=np.float32,
+    )
+    np.testing.assert_array_equal(got, np.asarray(expected[0], np.float32))
+    assert tel.snapshot()["counters"]["resilience.retries"] >= 1
+
+
+@pytest.mark.chaos
+def test_persistent_serve_batch_fault_dead_letters_with_reason(setup, tel):
+    predictor, _, texts = setup
+    # three one-shot clauses = every attempt of a 3-try policy fails
+    faults.configure(
+        "serve.batch=raise:RuntimeError:UNAVAILABLE a;"
+        "serve.batch=raise:RuntimeError:UNAVAILABLE b;"
+        "serve.batch=raise:RuntimeError:UNAVAILABLE c"
+    )
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(max_batch=8, max_wait_ms=3.0),
+        retry_policy=RetryPolicy(attempts=3, sleep=lambda s: None),
+    )
+    client = InprocessClient(service)
+    response = client.score(texts[0], timeout_s=30)  # must not hang
+    assert response["status"] == "error"
+    assert "UNAVAILABLE" in response["reason"]
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.dead_letters"] == 1
+    assert counters["serve.errors"] == 1
+    # the fault set is spent — the service recovers without a restart
+    faults.reset()
+    assert client.score(texts[0])["status"] == STATUS_OK
+    service.drain()
+
+
+@pytest.mark.chaos
+def test_non_transient_fault_dead_letters_without_burning_retries(setup, tel):
+    predictor, _, texts = setup
+    faults.configure("serve.batch=raise:ValueError:genuine bug")
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(max_batch=8, max_wait_ms=3.0),
+        retry_policy=RetryPolicy(attempts=3, sleep=lambda s: None),
+    )
+    response = InprocessClient(service).score(texts[0])
+    service.drain()
+    assert response["status"] == "error"
+    assert "genuine bug" in response["reason"]
+    assert tel.snapshot()["counters"].get("resilience.retries", 0) == 0
+
+
+# -- hot anchor-bank swap ------------------------------------------------------
+
+def sentinel_instances(n):
+    return [
+        {
+            "text1": f"sentinel weakness number {i} with deliberately new text",
+            "meta": {"label": f"SENTINEL#{i}", "type": "golden"},
+        }
+        for i in range(n)
+    ]
+
+
+def test_hot_bank_swap_under_load_never_tears(setup, tel, tmp_path):
+    """Mid-stream swap to a sentinel bank: every response is all-old or
+    all-new labels, the manifest commits the new version, and a
+    same-shape swap costs zero recompiles."""
+    predictor, _, texts = setup
+    run_dir = tmp_path / "swaprun"
+    service = make_service(predictor, tel_dir=run_dir)
+    client = InprocessClient(service)
+    manifest = json.loads((run_dir / MANIFEST_NAME).read_text())
+    assert manifest["version"] == 1
+    assert manifest["labels"] == list(predictor.anchor_labels)
+    manifest_v1_labels = manifest["labels"]
+
+    old_labels = set(predictor.anchor_labels)
+    new_labels = {f"SENTINEL#{i}" for i in range(len(old_labels))}
+    counts = {"old": 0, "new": 0, "torn": 0}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            response = client.score(texts[i % len(texts)])
+            if response["status"] == STATUS_OK:
+                keys = set(response["predict"])
+                if keys == old_labels and response["bank_version"] == 1:
+                    kind = "old"
+                elif keys == new_labels and response["bank_version"] == 2:
+                    kind = "new"
+                else:
+                    # a label set that matches neither bank, or labels
+                    # from one bank stamped with the other's version —
+                    # both are torn snapshots
+                    kind = "torn"
+                with lock:
+                    counts[kind] += 1
+            i += 1
+
+    loaders = [threading.Thread(target=load) for _ in range(4)]
+    for t in loaders:
+        t.start()
+    time.sleep(0.3)
+    traces_before = predictor.score_trace_count
+    version = service.swap_bank(sentinel_instances(len(old_labels)))
+    time.sleep(0.3)
+    stop.set()
+    for t in loaders:
+        t.join()
+    service.drain()
+
+    assert version == 2
+    assert counts["torn"] == 0
+    assert counts["old"] > 0 and counts["new"] > 0  # swap landed mid-stream
+    # same bank geometry → the warmed programs keep serving untraced
+    assert predictor.score_trace_count == traces_before
+    manifest = json.loads((run_dir / MANIFEST_NAME).read_text())
+    assert manifest["version"] == 2
+    assert set(manifest["labels"]) == new_labels
+    assert tel.snapshot()["counters"]["serve.bank_swaps"] == 1
+    # the swap lived in the service's snapshot only — the predictor's
+    # own installed bank is untouched, so later services start from v1
+    assert list(predictor.anchor_labels) == manifest_v1_labels
+
+
+def test_bank_swap_to_new_geometry_prewarms(setup, tel):
+    """A swap that changes the bank's row count compiles the new
+    programs BEFORE install (trace count moves at swap time, then stays
+    flat while serving the new bank)."""
+    predictor, _, texts = setup
+    service = make_service(predictor)
+    client = InprocessClient(service)
+    n_old = predictor.n_anchors
+    traces_before = predictor.score_trace_count
+    version = service.swap_bank(sentinel_instances(n_old + 3))
+    traces_after_swap = predictor.score_trace_count
+    assert traces_after_swap > traces_before  # pre-warm happened...
+    response = client.score(texts[0])
+    assert response["status"] == STATUS_OK
+    assert len(response["predict"]) == n_old + 3
+    assert response["bank_version"] == version
+    # ...and serving the new geometry added no further traces
+    assert predictor.score_trace_count == traces_after_swap
+    service.drain()
+
+
+# -- HTTP front end ------------------------------------------------------------
+
+def test_http_front_end_roundtrip(setup, tel):
+    predictor, _, texts = setup
+    service = make_service(predictor)
+    server = run_http_server(service, port=0)
+    try:
+        client = HTTPClient(
+            "http://127.0.0.1:%d" % server.server_address[1]
+        )
+        health = client.health()
+        assert health["status"] == "ok"
+        assert health["bank_version"] >= 1
+        response = client.score(texts[0])
+        assert response["status"] == STATUS_OK
+        assert response["predict"] and response["anchor"] in response["predict"]
+        # bad requests are 400s with a reason, not hangs
+        bad = client._request(urllib.request.Request(
+            client.base_url + "/score",
+            data=b'{"no_text": 1}',
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        ))
+        assert bad["status"] == "error" and "bad request" in bad["reason"]
+        missing = client._request(urllib.request.Request(
+            client.base_url + "/nope", method="GET"
+        ))
+        assert missing["status"] == "error"
+    finally:
+        server.shutdown()
+        service.drain()
+
+
+# -- config + archive entry point ----------------------------------------------
+
+def test_serving_config_section_defaults_and_overrides():
+    from memvul_tpu.config import SERVING_DEFAULTS, serving_config
+
+    cfg = serving_config(None)
+    assert cfg == SERVING_DEFAULTS
+    cfg = serving_config({"serving": {"max_batch": 32, "max_queue": None}})
+    assert cfg["max_batch"] == 32
+    assert cfg["max_queue"] == SERVING_DEFAULTS["max_queue"]  # null → default
+
+
+def test_serve_from_archive_end_to_end(ws, tmp_path, tel):
+    """Archive → warmed service, sized by the ``serving`` config
+    section, manifest + telemetry in the out dir."""
+    from memvul_tpu.archive import save_archive
+    from memvul_tpu.build import build_model, init_params, serve_from_archive
+
+    model_cfg = {
+        "type": "model_memory",
+        "encoder": {"preset": "tiny", "vocab_size": 4096},
+        "header_dim": 32,
+    }
+    config = {
+        "tokenizer": {
+            "type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"],
+        },
+        "dataset_reader": {
+            "type": "reader_memory",
+            "anchor_path": ws["paths"]["anchors"],
+            "cve_path": ws["paths"]["cve"],
+        },
+        "model": model_cfg,
+        "serving": {"max_batch": 4, "buckets": [16, 48], "max_length": 48},
+    }
+    model = build_model(dict(model_cfg), 4096)
+    params = init_params(model, seed=0)
+    archive = save_archive(
+        tmp_path / "model.tar.gz", config, params,
+        tokenizer_file=ws["paths"]["tokenizer"],
+    )
+    out_dir = tmp_path / "serve_run"
+    service = serve_from_archive(archive, out_dir=out_dir)
+    try:
+        assert service.config.max_batch == 4
+        assert service.predictor.buckets == (16, 48)
+        assert (out_dir / MANIFEST_NAME).exists()
+        traces = service.predictor.score_trace_count
+        response = InprocessClient(service).score("a memory safety bug")
+        assert response["status"] == STATUS_OK
+        assert set(response["predict"]) == set(service.predictor.anchor_labels)
+        assert service.predictor.score_trace_count == traces  # warmed
+    finally:
+        service.drain()
+        telemetry.get_registry().close()
+
+    # a single-model archive is refused with a clear error
+    single_cfg = dict(config, model={
+        "type": "model_single",
+        "encoder": {"preset": "tiny", "vocab_size": 4096},
+        "header_dim": 32,
+    })
+    single_model = build_model(dict(single_cfg["model"]), 4096)
+    bad = save_archive(
+        tmp_path / "single.tar.gz", single_cfg,
+        init_params(single_model, seed=0),
+        tokenizer_file=ws["paths"]["tokenizer"],
+    )
+    with pytest.raises(ValueError, match="Siamese"):
+        serve_from_archive(bad)

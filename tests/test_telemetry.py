@@ -406,3 +406,54 @@ def test_bench_watchdog_record_carries_heartbeat_age(monkeypatch, capsys):
     assert record["watchdog_timeout"] is True
     assert isinstance(record["heartbeat_age_s"], float)
     assert record["heartbeat_age_s"] >= 0.0
+
+
+# -- degenerate run dirs (the "server died before its first event" class) ------
+
+
+def test_report_empty_and_heartbeat_only_run_dirs(tmp_path):
+    """telemetry-report over empty / heartbeat-only run dirs renders a
+    clear "no events recorded" line instead of crashing or pretending
+    telemetry was never configured (the satellite regression: a serve
+    run SIGKILLed before its first event flush leaves exactly this)."""
+    # sink files exist but are empty: say so, don't claim "no sinks"
+    empty_sinks = tmp_path / "empty_sinks"
+    empty_sinks.mkdir()
+    (empty_sinks / "events.jsonl").write_text("")
+    (empty_sinks / "telemetry.json").write_text("{}")
+    text = render_report(empty_sinks)
+    assert "no events recorded" in text
+    assert "events.jsonl" in text and "telemetry.json" in text
+    assert "no telemetry sinks" not in text
+
+    # heartbeat-only (stale liveness, no event stream): both facts render
+    hb_only = tmp_path / "hb_only"
+    hb_only.mkdir()
+    (hb_only / "HEARTBEAT.json").write_text(json.dumps({
+        "phase": "serve", "pid": 1234, "written_wall": 100.0,
+        "uptime_s": 5.0, "counters": {"serve.requests": 3},
+    }))
+    text = render_report(hb_only, now=400.0)
+    assert "no events recorded" in text
+    assert "serve" in text and "300.000s ago" in text
+    assert "serve.requests = 3" in text  # heartbeat counters still shown
+
+    # garbled heartbeat values degrade to "-", never a format crash
+    (hb_only / "HEARTBEAT.json").write_text(json.dumps({
+        "phase": "serve", "written_wall": "not-a-number", "uptime_s": "x",
+    }))
+    text = render_report(hb_only)
+    assert "- ago" in text
+
+
+def test_report_cli_exit_codes_on_degenerate_dirs(tmp_path, capsys):
+    from memvul_tpu.__main__ import main
+
+    empty = tmp_path / "really_empty"
+    empty.mkdir()
+    assert main(["telemetry-report", str(empty)]) == 0
+    assert "no telemetry sinks" in capsys.readouterr().out
+    (empty / "events.jsonl").write_text("")
+    assert main(["telemetry-report", str(empty)]) == 0
+    assert "no events recorded" in capsys.readouterr().out
+    assert main(["telemetry-report", str(tmp_path / "missing")]) == 2

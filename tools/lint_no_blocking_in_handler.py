@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Lint: HTTP handler threads may only enqueue + wait on a future.
+
+The serving front end (memvul_tpu/serving/frontend.py) runs one thread
+per connection.  A handler that calls ``time.sleep`` or any scoring/
+encoding entry point inline serializes the whole server behind one
+connection and — worse — can trigger the mid-serve XLA compiles the
+micro-batcher exists to prevent (docs/serving.md).  The allowed surface
+is exactly: ``service.submit(...)`` and ``future.result(...)``.
+
+The check is AST-based: every class whose base name ends with
+``RequestHandler`` (stdlib ``BaseHTTPRequestHandler`` or a subclass) is
+scanned for calls to a blocking/scoring name, wherever the class lives
+under the package dir.  Flagged names:
+
+* ``sleep`` (``time.sleep`` or a bare imported ``sleep``);
+* anything starting with ``predict`` (``predict_file``, ``predict_one``);
+* the scoring/encoding entry points: ``score_instances``,
+  ``encode_anchors``, ``encode_bank``, ``warmup_compile``,
+  ``warmup_bank_shapes``, ``swap_bank``, and the raw jitted program
+  ``_score_fn``.
+
+Usage: ``python tools/lint_no_blocking_in_handler.py [package_dir]`` —
+exits 1 listing offenders, 0 when clean, 2 on a bad argument.  Invoked
+as a tier-1 test from ``tests/test_no_blocking_in_handler.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+FORBIDDEN_NAMES = {
+    "sleep",
+    "score_instances",
+    "encode_anchors",
+    "encode_bank",
+    "warmup_compile",
+    "warmup_bank_shapes",
+    "swap_bank",
+    "_score_fn",
+}
+FORBIDDEN_PREFIXES = ("predict",)
+
+
+def _called_name(node: ast.Call) -> str:
+    """The terminal name of a call: ``time.sleep(...)`` → "sleep",
+    ``service.predictor.predict_file(...)`` → "predict_file"."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_handler_class(node: ast.ClassDef) -> bool:
+    for base in node.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        if name.endswith("RequestHandler"):
+            return True
+    return False
+
+
+def find_blocking_calls(package_dir: Path) -> List[str]:
+    """``path:line: name`` for every forbidden call inside a
+    ``*RequestHandler`` subclass under ``package_dir``."""
+    offenders: List[str] = []
+    for path in sorted(package_dir.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        except SyntaxError as e:  # a file that doesn't parse is its own bug
+            offenders.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.ClassDef) and _is_handler_class(node)):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = _called_name(call)
+                if name in FORBIDDEN_NAMES or name.startswith(FORBIDDEN_PREFIXES):
+                    offenders.append(f"{path}:{call.lineno}: {name}")
+    return offenders
+
+
+def main(argv: List[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        package_dir = Path(argv[0])
+    else:
+        package_dir = Path(__file__).resolve().parent.parent / "memvul_tpu"
+    if not package_dir.is_dir():
+        print(f"lint_no_blocking_in_handler: {package_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    offenders = find_blocking_calls(package_dir)
+    for line in offenders:
+        print(f"blocking call in HTTP handler: {line}")
+    if offenders:
+        print(
+            f"{len(offenders)} blocking call(s) in handler classes — a "
+            "handler may only submit() and wait on the future "
+            "(docs/serving.md)"
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
