@@ -42,7 +42,11 @@ pass, BENCH_MICRO_{B,A,D,ITERS} set its shape; serve — drive the online
 scoring service (docs/serving.md) with closed-loop in-process clients
 and report request throughput + latency percentiles,
 BENCH_MICRO_REQUESTS/BENCH_MICRO_CLIENTS set the load,
-BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher),
+BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher;
+train_step — A/B the Siamese train step's collation, pad-to-max vs
+bucketed+anchor-dedup over one identical pair stream, reporting padded-
+vs real-token throughput for both paths,
+BENCH_TRAIN_{STEPS,BATCH,ACCUM} set the load — docs/training_throughput.md),
 BENCH_PHASE_TIMEOUT (per-phase watchdog deadline inside the child,
 default 600 s, 0 disables — a stuck phase emits a parseable JSON
 failure record naming the phase, its last-heartbeat age (stuck phase vs
@@ -182,10 +186,13 @@ def _run_bench() -> None:
     if os.environ.get("BENCH_MICRO") == "serve":
         _run_serve_micro()
         return
+    if os.environ.get("BENCH_MICRO") == "train_step":
+        _run_train_step_micro()
+        return
     if os.environ.get("BENCH_MICRO"):
         raise ValueError(
             f"unknown BENCH_MICRO mode {os.environ['BENCH_MICRO']!r} "
-            "(known: anchor_match, serve)"
+            "(known: anchor_match, serve, train_step)"
         )
     import numpy as np
     import jax
@@ -481,6 +488,131 @@ def _run_anchor_match_micro() -> None:
                     "B": b, "A": a, "D": d, "iters": iters,
                     "dtype": str(jnp.dtype(dtype)),
                     "fused_backend": fused_backend,
+                },
+            }
+        )
+    )
+
+
+def _run_train_step_micro() -> None:
+    """BENCH_MICRO=train_step: Siamese train-step throughput, pad-to-max
+    vs bucketed+dedup collation (docs/training_throughput.md).
+
+    Runs the SAME epoch pair stream (identical reader seed → identical
+    pairs) through two MemoryTrainers that differ only in collation:
+    ``train_buckets=None`` (the pre-PR-5 pad-to-max baseline) vs the
+    default bucket grid with in-batch anchor deduplication.  Each path
+    gets one warmup epoch (compiles) and one timed epoch over the same
+    stream, then one JSON line reports wall-clock plus BOTH token
+    throughputs per path — padded tokens/s is what the device computed,
+    real tokens/s is what the corpus contained; the bucketed path's win
+    is real-token throughput at a lower padded-token bill.
+
+    Knobs: BENCH_TRAIN_STEPS (optimizer steps per epoch, default 16),
+    BENCH_TRAIN_BATCH (default 32), BENCH_TRAIN_ACCUM (default 2),
+    BENCH_TRAIN_REPORTS (workspace reports per project, default 256),
+    BENCH_SEQ_LEN (max_length cap, default 512), BENCH_MODEL
+    (base | tiny — tiny exercises the full path off-TPU in seconds; the
+    recorded number is only meaningful at base geometry on hardware).
+    """
+    import numpy as np
+    import jax
+
+    from memvul_tpu.utils.platform import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+    import jax.numpy as jnp
+
+    from memvul_tpu.data.readers import MemoryReader
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.models import BertConfig, MemoryModel
+    from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+    watchdog = _watchdog()
+    steps = int(os.environ.get("BENCH_TRAIN_STEPS", "16"))
+    batch = int(os.environ.get("BENCH_TRAIN_BATCH", "32"))
+    accum = int(os.environ.get("BENCH_TRAIN_ACCUM", "2"))
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
+    per_project = int(os.environ.get("BENCH_TRAIN_REPORTS", "256"))
+
+    with watchdog.phase("workspace"):
+        ws = build_workspace(
+            tempfile.mkdtemp(), seed=0, num_projects=8,
+            reports_per_project=per_project, realistic_lengths=True,
+        )
+    if os.environ.get("BENCH_MODEL", "base") == "tiny":
+        cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+        seq_len = min(seq_len, cfg.max_position_embeddings)
+    else:
+        cfg = BertConfig.base(
+            vocab_size=max(30522, ws["tokenizer"].vocab_size), dtype=jnp.bfloat16
+        )
+        if seq_len > cfg.max_position_embeddings:
+            cfg = cfg.replace(max_position_embeddings=seq_len)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    with watchdog.phase("model_init"):
+        params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+
+    def run_path(name: str, **cfg_kw):
+        reader = MemoryReader(
+            cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"],
+            sample_neg=0.5, seed=2021,
+        )
+        trainer = MemoryTrainer(
+            model,
+            # each path gets its own buffers: the jitted step DONATES
+            # params/opt-state, so sharing one pytree across the A/B
+            # would hand path B already-deleted arrays
+            jax.tree_util.tree_map(jnp.array, params),
+            ws["tokenizer"], reader,
+            train_path=ws["paths"]["train"],
+            config=TrainerConfig(
+                batch_size=batch, grad_accum=accum, max_length=seq_len,
+                steps_per_epoch=steps, num_epochs=1, warmup_steps=1,
+                serialization_dir=None, **cfg_kw,
+            ),
+        )
+        # warmup epoch compiles every stack shape; the timed epoch
+        # replays the SAME epoch-0 stream (train_epoch does not advance
+        # trainer.epoch), so both epochs and both paths see one stream
+        with watchdog.phase(f"{name}_warmup"):
+            trainer.train_epoch()
+        with watchdog.phase(f"{name}_timed"):
+            m = trainer.train_epoch()
+        return {
+            "epoch_s": round(m["epoch_seconds"], 4),
+            "steps": m["num_steps"],
+            "padded_tokens": m["padded_tokens"],
+            "real_tokens": m["real_tokens"],
+            "padded_tokens_per_s": round(m["tokens_per_sec"], 1),
+            "real_tokens_per_s": round(m["real_tokens_per_sec"], 1),
+            "compiled_step_shapes": trainer.train_trace_count,
+        }
+
+    pad = run_path("pad_to_max", train_buckets=None, dedup_anchors=False)
+    bucketed = run_path("bucketed_dedup")  # defaults: pow2 grid + dedup
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_step_microbench",
+                # headline: wall-clock speedup over the identical stream
+                "value": round(pad["epoch_s"] / max(bucketed["epoch_s"], 1e-9), 3),
+                "unit": "x (pad_to_max_s / bucketed_dedup_s)",
+                "vs_baseline": 0.0,  # no external training baseline (BASELINE.md)
+                "pad_to_max": pad,
+                "bucketed_dedup": bucketed,
+                "config": {
+                    "model": os.environ.get("BENCH_MODEL", "base"),
+                    "seq_len": seq_len,
+                    "batch_size": batch,
+                    "grad_accum": accum,
+                    "steps_per_epoch": steps,
                 },
             }
         )

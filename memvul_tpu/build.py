@@ -245,7 +245,10 @@ def train_from_config(
                 "pretrained_checkpoint %s missing — training from scratch", ckpt
             )
 
-    trainer_cfg = dict(config.get("trainer") or {})
+    from .config import validate_training_config
+
+    # fail on a bad feed depth / bucket grid here, not minutes into epoch 0
+    trainer_cfg = validate_training_config(config.get("trainer"))
     trainer_cfg.setdefault("seed", seed)
     trainer_cfg["serialization_dir"] = str(serialization_dir)
     if tel_cfg["trace_dir"] and not trainer_cfg.get("profile_dir"):

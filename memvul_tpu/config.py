@@ -272,6 +272,40 @@ def evaluation_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     return _section_over_defaults(cfg, "evaluation", EVALUATION_DEFAULTS)
 
 
+# Training-section knobs that the TrainerConfig dataclasses own the
+# defaults for but that are worth failing EARLY on — a bad
+# prefetch_depth or a non-covering bucket list otherwise surfaces
+# minutes into a run (or silently truncates sequences).  Called by
+# build.train_from_config before the trainer is constructed.
+def validate_training_config(trainer: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Sanity-check the config's ``trainer`` section (returns it).
+
+    * ``prefetch_depth`` must be >= 1 (the feed queue would deadlock at 0);
+    * ``train_buckets`` must be "pow2", null, or a list whose largest
+      bucket covers ``max_length`` (docs/training_throughput.md) —
+      resolved through the same helper the trainers use so the two can't
+      drift;
+    * ``dedup_anchors`` must be a bool (a truthy string like "false"
+      would silently enable it).
+    """
+    trainer = dict(trainer or {})
+    depth = trainer.get("prefetch_depth", 8)
+    if int(depth) < 1:
+        raise ValueError(
+            f"trainer.prefetch_depth must be >= 1, got {depth!r}"
+        )
+    from .data.batching import resolve_train_buckets
+
+    max_length = int(trainer.get("max_length", 256))
+    resolve_train_buckets(trainer.get("train_buckets", "pow2"), max_length)
+    dedup = trainer.get("dedup_anchors", True)
+    if not isinstance(dedup, bool):
+        raise ValueError(
+            f"trainer.dedup_anchors must be a bool, got {dedup!r}"
+        )
+    return trainer
+
+
 # The ``serving`` config section (docs/serving.md).  Read by
 # build.serve_from_archive, which sizes the online predictor (the
 # micro-batch IS its batch shape set, so ``max_batch``/``buckets`` here

@@ -3,10 +3,17 @@
 XLA compiles one program per input shape, so batches must arrive in a
 small closed set of shapes.  This module pads every batch to a fixed
 ``batch_size`` (partial tails are padded with dead rows, marked by a
-``weight`` vector) and pads sequences to bucketed lengths.  It also
-memoizes text→ids (CVE descriptions and anchors repeat heavily in the
-pair stream) and can prefetch batches on a background thread so host-side
-tokenization stays off the TPU critical path.
+``weight`` vector) and pads sequences to bucketed lengths — single-text
+streams through :func:`bucketed_batches_from_instances` (the
+corpus-scoring path), Siamese pair streams through
+:func:`bucketed_pair_batches_from_instances` (the training path:
+per-side bucket grid + in-batch side-2 dedup,
+docs/training_throughput.md).  It also memoizes text→ids (CVE
+descriptions and anchors repeat heavily in the pair stream; hit/miss
+telemetry makes the memo auditable) and can prefetch batches on a
+background thread — optionally committing them to device there too (the
+double-buffered feed) — so host-side tokenization and H2D transfer stay
+off the TPU critical path.
 """
 
 from __future__ import annotations
@@ -22,7 +29,12 @@ LABELS_BINARY = {"pos": 0, "neg": 1}
 
 
 class CachedEncoder:
-    """Memoizing wrapper around ``tokenizer.encode``."""
+    """Memoizing wrapper around ``tokenizer.encode``.
+
+    Hit/miss totals feed the ``data.encode_cache_hits`` /
+    ``data.encode_cache_misses`` telemetry counters (one batched ``inc``
+    per call, not per text) so host-side tokenization cost shows up in
+    ``telemetry-report`` instead of hiding inside wall-clock."""
 
     def __init__(self, tokenizer, max_length: int, cache_size: int = 200_000):
         self._tokenizer = tokenizer
@@ -39,11 +51,16 @@ class CachedEncoder:
         return self._max_length
 
     def __call__(self, text: str) -> List[int]:
+        from ..telemetry import get_registry
+
         ids = self._cache.get(text)
         if ids is None:
+            get_registry().counter("data.encode_cache_misses").inc()
             ids = self._tokenizer.encode(text, max_length=self._max_length)
             if len(self._cache) < self._cache_size:
                 self._cache[text] = ids
+        else:
+            get_registry().counter("data.encode_cache_hits").inc()
         return ids
 
     def encode_many(self, texts: Sequence[str]) -> List[List[int]]:
@@ -52,6 +69,8 @@ class CachedEncoder:
         scaling path for multi-core hosts), so repeated texts (anchors,
         CVE descriptions) still hit the memo and only unique misses pay
         tokenization."""
+        from ..telemetry import get_registry
+
         fresh: Dict[str, List[int]] = {}
         misses = [t for t in dict.fromkeys(texts) if t not in self._cache]
         if misses:
@@ -62,6 +81,9 @@ class CachedEncoder:
                 fresh[t] = ids
                 if len(self._cache) < self._cache_size:
                     self._cache[t] = ids
+        tel = get_registry()
+        tel.counter("data.encode_cache_misses").inc(len(misses))
+        tel.counter("data.encode_cache_hits").inc(len(texts) - len(misses))
         return [
             self._cache[t] if t in self._cache else fresh[t] for t in texts
         ]
@@ -89,13 +111,30 @@ def _pad_block(
     return {"input_ids": ids, "attention_mask": mask}
 
 
+def _bucket_for(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket covering ``length``.  A sequence longer than the
+    largest bucket is clamped to it EXPLICITLY and counted
+    (``data.truncated_sequences``) — the old behavior relied on the
+    downstream ``seq[:length]`` slice in :func:`_pad_block` silently
+    dropping the tail, which :func:`validate_buckets` exists to prevent
+    but nothing measured when it happened anyway (an unvalidated caller,
+    or a tokenizer whose cap disagrees with the bucket grid)."""
+    for b in buckets:
+        if b >= length:
+            return b
+    from ..telemetry import get_registry
+
+    get_registry().counter("data.truncated_sequences").inc()
+    return buckets[-1]
+
+
 def _bucket_length(
     seqs: Iterable[List[int]], buckets: Optional[Sequence[int]], max_length: int
 ) -> int:
     longest = max((len(s) for s in seqs), default=1)
     longest = min(longest, max_length)
     if buckets:
-        return next((b for b in buckets if b >= longest), buckets[-1])
+        return _bucket_for(longest, buckets)
     return max_length
 
 
@@ -206,7 +245,7 @@ def bucketed_batches_from_instances(
                 )
             texts.append(inst["text1"])
         for inst, seq in zip(block, _encode_many(encoder, texts)):
-            bucket = next((b for b in buckets if b >= len(seq)), buckets[-1])
+            bucket = _bucket_for(len(seq), buckets)
             slot = dict(inst)
             slot["_ids"] = seq
             pending[bucket].append(slot)
@@ -274,6 +313,160 @@ def _collate_bucket(
         ),
         "meta": [inst.get("meta", {}) for inst in chunk],
     }
+
+
+def dedup_capacities(batch_size: int, floor: int = 8) -> Tuple[int, ...]:
+    """The CLOSED set of unique-row capacities a deduped side-2 block may
+    take for a given row count: powers of two from ``floor`` up, plus the
+    row count itself.  A per-batch capacity (the exact unique count) would
+    compile one program per distinct U — this ladder caps the program
+    count at ~log2(B/8) per bucket cell while still cutting tower-2 rows
+    to the nearest power of two above U."""
+    caps: List[int] = []
+    c = floor
+    while c < batch_size:
+        caps.append(c)
+        c *= 2
+    caps.append(int(batch_size))
+    return tuple(caps)
+
+
+def _dedup_side2(
+    seqs: Sequence[List[int]], batch_size: int, cap_floor: int = 8
+) -> Tuple[List[List[int]], np.ndarray, int]:
+    """Order-preserving unique rows + per-row gather indices.
+
+    Returns ``(unique_seqs, index[batch_size], capacity)`` where
+    ``capacity`` is the smallest value in :func:`dedup_capacities`
+    covering the unique count.  Rows beyond ``len(seqs)`` (dead rows) map
+    to index 0 — they carry zero weight, so what they gather is inert.
+    """
+    unique: Dict[Tuple[int, ...], int] = {}
+    index = np.zeros(batch_size, dtype=np.int32)
+    seq_list: List[List[int]] = []
+    for i, seq in enumerate(seqs):
+        key = tuple(seq)
+        slot = unique.get(key)
+        if slot is None:
+            slot = unique[key] = len(seq_list)
+            seq_list.append(seq)
+        index[i] = slot
+    cap = next(
+        c for c in dedup_capacities(batch_size, floor=cap_floor)
+        if c >= len(seq_list)
+    )
+    return seq_list, index, cap
+
+
+def bucketed_pair_batches_from_instances(
+    instances: Iterable[Dict],
+    encoder: CachedEncoder,
+    batch_size: Union[int, Dict[int, int]],
+    label_map: Optional[Dict[str, int]] = None,
+    buckets: Sequence[int] = (64, 128, 256, 512),
+    dedup_side2: bool = True,
+    dedup_cap_floor: int = 8,
+) -> Iterator[Dict]:
+    """Length-binned batching for Siamese PAIR streams — the training-side
+    twin of :func:`bucketed_batches_from_instances`.
+
+    Each pair is routed to the grid cell ``(b1, b2)`` of the smallest
+    buckets covering its two sides independently (the report side and the
+    anchor/CVE side have very different length distributions — anchors
+    are short, reports are long-tailed — so one shared bucket would pad
+    the short side to the long side's length).  A batch is emitted when a
+    cell fills; tails flush as dead-row-padded batches when the stream
+    ends.  The compiled-program count is bounded by the grid:
+    ``|buckets|²`` cells times the dedup capacity ladder.
+
+    ``batch_size`` may map the SIDE-1 bucket to a row count (per-bucket
+    batch sizes, cf. :func:`bucket_batch_sizes`) — note that for
+    *training* a varying row count also varies the optimizer's effective
+    batch, so the trainers default to a constant int.
+
+    With ``dedup_side2`` the second side is emitted as its UNIQUE rows
+    (``sample2`` [cap, L2], capacity from :func:`dedup_capacities`) plus
+    a ``sample2_index`` [B] gather map: the pair stream repeats the ~129
+    anchor texts and the same-CWE CVE descriptions heavily, so tower-2
+    forward/backward FLOPs drop from B rows to U ≤ unique texts while
+    gradients scatter-add through the gather automatically
+    (docs/training_throughput.md).  ``dedup_cap_floor`` raises the
+    capacity ladder's floor — a data-sharded trainer passes its mesh
+    axis size so every unique block stays divisible across the mesh.
+    """
+    label_map = label_map or LABELS_SIAMESE
+    buckets = tuple(sorted(int(b) for b in buckets))
+    if isinstance(batch_size, dict):
+        sizes = {b: int(batch_size[b]) for b in buckets}
+    else:
+        sizes = {b: int(batch_size) for b in buckets}
+    pending: Dict[Tuple[int, int], List[Dict]] = {}
+    for block in _blocks(instances, 512):
+        for inst in block:
+            if inst.get("text2") is None:
+                raise ValueError(
+                    "bucketed pair batching needs text2 on every instance; "
+                    "single-text streams use bucketed_batches_from_instances"
+                )
+        seqs1 = _encode_many(encoder, [inst["text1"] for inst in block])
+        seqs2 = _encode_many(encoder, [inst["text2"] for inst in block])
+        for inst, s1, s2 in zip(block, seqs1, seqs2):
+            cell = (_bucket_for(len(s1), buckets), _bucket_for(len(s2), buckets))
+            slot = dict(inst)
+            slot["_ids1"], slot["_ids2"] = s1, s2
+            rows = pending.setdefault(cell, [])
+            rows.append(slot)
+            if len(rows) == sizes[cell[0]]:
+                yield _collate_pair_cell(
+                    rows, encoder, sizes[cell[0]], label_map, cell,
+                    dedup_side2, dedup_cap_floor,
+                )
+                pending[cell] = []
+    for cell in sorted(pending):
+        if pending[cell]:
+            yield _collate_pair_cell(
+                pending[cell], encoder, sizes[cell[0]], label_map, cell,
+                dedup_side2, dedup_cap_floor,
+            )
+
+
+def _collate_pair_cell(
+    chunk: List[Dict],
+    encoder: CachedEncoder,
+    batch_size: int,
+    label_map: Dict[str, int],
+    cell: Tuple[int, int],
+    dedup: bool,
+    dedup_cap_floor: int = 8,
+) -> Dict:
+    length1, length2 = cell
+    labels = []
+    for inst in chunk:
+        label = inst.get("label")
+        if label not in label_map:
+            raise ValueError(
+                f"label {label!r} not in label map {sorted(label_map)}; "
+                "pass the matching label_map for this reader"
+            )
+        labels.append(label_map[label])
+    batch: Dict = {
+        "sample1": _pad_block(
+            [inst["_ids1"] for inst in chunk], batch_size, encoder.pad_id, length1
+        ),
+        "label": np.array(labels + [0] * (batch_size - len(chunk)), dtype=np.int32),
+        "weight": np.array(
+            [1.0] * len(chunk) + [0.0] * (batch_size - len(chunk)), dtype=np.float32
+        ),
+        "meta": [inst.get("meta", {}) for inst in chunk],
+    }
+    seqs2 = [inst["_ids2"] for inst in chunk]
+    if dedup:
+        unique, index, cap = _dedup_side2(seqs2, batch_size, dedup_cap_floor)
+        batch["sample2"] = _pad_block(unique, cap, encoder.pad_id, length2)
+        batch["sample2_index"] = index
+    else:
+        batch["sample2"] = _pad_block(seqs2, batch_size, encoder.pad_id, length2)
+    return batch
 
 
 def inflight_pipeline(
@@ -376,6 +569,41 @@ def auto_buckets(
     return tuple(sorted(set(bounds) | {max_length}))
 
 
+def pow2_buckets(max_length: int, floor: int = 64) -> Tuple[int, ...]:
+    """Powers of two from ``floor`` up, capped by (and always including)
+    ``max_length`` — the default training bucket grid.  Hand powers of
+    two, not the corpus-sampled DP of :func:`auto_buckets`: the training
+    pair stream is resampled every epoch, so there is no stable length
+    sample to optimize against at trainer-construction time."""
+    out: List[int] = []
+    b = int(floor)
+    while b < max_length:
+        out.append(b)
+        b *= 2
+    out.append(int(max_length))
+    return tuple(out)
+
+
+def resolve_train_buckets(
+    spec, max_length: int
+) -> Optional[Tuple[int, ...]]:
+    """The trainer configs' ``train_buckets`` knob → a validated bucket
+    tuple: ``"pow2"`` (the default) derives :func:`pow2_buckets`,
+    ``None`` means pad-to-max (the pre-bucketing collation, kept as the
+    microbench baseline), and an explicit list is checked for
+    ``max_length`` coverage via :func:`validate_buckets`."""
+    if spec is None:
+        return None
+    if spec == "pow2":
+        return pow2_buckets(max_length)
+    if isinstance(spec, str):
+        raise ValueError(
+            f"train_buckets {spec!r} not understood: use 'pow2', null "
+            "(pad-to-max), or an explicit bucket list"
+        )
+    return validate_buckets([int(b) for b in spec], max_length)
+
+
 def validate_buckets(buckets: Sequence[int], max_length: int) -> Tuple[int, ...]:
     """Buckets must cover ``max_length`` — otherwise every sequence longer
     than the largest bucket would be silently truncated below the
@@ -392,8 +620,27 @@ def validate_buckets(buckets: Sequence[int], max_length: int) -> Tuple[int, ...]
     return out
 
 
-def prefetch(iterator: Iterator, depth: int = 4) -> Iterator:
+def prefetch(
+    iterator: Iterator,
+    depth: int = 4,
+    commit=None,
+    occupancy=None,
+) -> Iterator:
     """Run ``iterator`` on a background thread with a bounded queue.
+
+    With ``commit`` (e.g. ``jax.device_put`` or a sharded put) the worker
+    applies it to every item BEFORE enqueueing, so host collation *and*
+    the H2D transfer overlap the consumer's running device step — the
+    double-buffered feed: while step N runs, batch N+1 is already
+    committed on device and batch N+2 is being collated.  The consumer
+    never pays a transfer on its critical path; JAX dispatch being async,
+    ``commit`` only enqueues the copy.  (``depth`` then also bounds how
+    many committed batches sit in device memory ahead of the step.)
+
+    ``occupancy`` (a telemetry gauge) tracks the queue fill after every
+    put/get: a gauge pinned at 0 means the feed is the bottleneck (the
+    step waits on collation/transfer), pinned at ``depth`` means the
+    device is (docs/training_throughput.md).
 
     Safe against early consumer exit: closing/abandoning the generator
     unblocks and stops the worker rather than leaking a thread pinned on a
@@ -408,6 +655,8 @@ def prefetch(iterator: Iterator, depth: int = 4) -> Iterator:
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
+                if occupancy is not None:
+                    occupancy.set(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -416,6 +665,8 @@ def prefetch(iterator: Iterator, depth: int = 4) -> Iterator:
     def worker() -> None:
         try:
             for item in iterator:
+                if commit is not None:
+                    item = commit(item)
                 if not _put(item):
                     return
         except BaseException as e:  # propagate into the consumer
@@ -428,6 +679,8 @@ def prefetch(iterator: Iterator, depth: int = 4) -> Iterator:
     try:
         while True:
             item = q.get()
+            if occupancy is not None:
+                occupancy.set(q.qsize())
             if item is _END:
                 if error:
                     raise error[0]

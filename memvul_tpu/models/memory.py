@@ -130,17 +130,31 @@ class MemoryModel(nn.Module):
         anchors: Optional[jax.Array] = None,
         deterministic: bool = True,
         anchor_impl: Optional[str] = None,
+        sample2_index: Optional[jax.Array] = None,
     ):
         """Training: (sample1, sample2) → pair logits [B, 2].
         Inference: (sample1, anchors=[A, D]) → anchor logits [B, A, 2].
         ``anchor_impl`` overrides ``config.anchor_match_impl`` per call
-        (the predictor forces "xla" when the bank is model-sharded)."""
+        (the predictor forces "xla" when the bank is model-sharded).
+
+        ``sample2_index`` enables in-batch anchor deduplication: sample2
+        then holds only the batch's UNIQUE second-side rows [U, L] and
+        the [B] index gathers each pair's embedding back to its position
+        — tower-2 runs U ≤ B rows instead of B, and gradients scatter-add
+        through the gather automatically.  The gather is exact (bitwise:
+        duplicate pairs share one embedding row), so pair losses match
+        the undeduped batch up to the batch-size sensitivity of the
+        encoder itself (parity pinned in tests/test_train_throughput.py).
+        """
         u = self.encode(sample1, deterministic=deterministic)
         if anchors is not None:
             return self.match_anchors(u, anchors, impl=anchor_impl)
         if sample2 is None:
             return u
         v = self.encode(sample2, deterministic=deterministic)
+        if sample2_index is not None:
+            with jax.named_scope("anchor_dedup_gather"):
+                v = jnp.take(v, sample2_index, axis=0)
         return self.pair_logits(u, v)
 
     def loss(self, logits, labels, weights) -> jax.Array:

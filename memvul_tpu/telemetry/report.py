@@ -172,6 +172,21 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
         lines.append("COUNTERS")
         for name in sorted(counters):
             lines.append(f"  {name} = {_fmt_num(counters[name])}")
+        # derived: host-side tokenization cache effectiveness — the
+        # CachedEncoder counters make host tokenization cost attributable
+        # (a low hit rate on a pair-training run means the memo is being
+        # evicted or the stream has no repeats to exploit)
+        try:
+            hits = float(counters["data.encode_cache_hits"])
+            misses = float(counters["data.encode_cache_misses"])
+            total = hits + misses
+        except (KeyError, TypeError, ValueError):
+            total = 0.0
+        if total > 0:
+            lines.append(
+                f"  data.encode_cache_hit_rate = {hits / total:.3f}"
+                f" ({int(hits)}/{int(total)} lookups)"
+            )
     gauges = summary.get("gauges") or {}
     if gauges:
         lines.append("")

@@ -22,7 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..data.batching import LABELS_BINARY, CachedEncoder, batches_from_instances, prefetch
+from ..data.batching import (
+    LABELS_BINARY,
+    CachedEncoder,
+    batches_from_instances,
+    bucketed_batches_from_instances,
+    prefetch,
+    resolve_train_buckets,
+)
 from ..data.readers import DatasetReader
 from ..models.losses import masked_cross_entropy
 from ..parallel.mesh import replicate, shard_batch
@@ -84,6 +91,14 @@ class ClassifierTrainerConfig:
     validation_metric: str = "+pos_f1-score"
     batch_size: int = 64
     max_length: int = 256
+    # length-binned TRAIN collation (same contract as the memory
+    # trainer's knob, docs/training_throughput.md): "pow2" derives
+    # power-of-two buckets up to max_length, an explicit list is
+    # coverage-validated, None = pad-to-max (the pre-bucketing baseline)
+    train_buckets: Union[str, Sequence[int], None] = "pow2"
+    # feed queue depth: collation + committed H2D run this many batches
+    # ahead of the step on the prefetch worker (≥ 1)
+    prefetch_depth: int = 8
     eval_batch_size: int = 512
     eval_max_length: int = 512
     # length-binned validation (same mechanism as the memory trainer's
@@ -139,6 +154,12 @@ class ClassifierTrainer:
 
         c = self.config
         self.encoder = CachedEncoder(tokenizer, max_length=c.max_length)
+        if int(c.prefetch_depth) < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {c.prefetch_depth} "
+                "(1 = no read-ahead; 0 would deadlock the feed queue)"
+            )
+        self.train_buckets = resolve_train_buckets(c.train_buckets, c.max_length)
         self.tx, opt_state = make_optimizer(
             params,
             group_lrs=c.group_lrs,
@@ -185,20 +206,51 @@ class ClassifierTrainer:
 
     # -- data ----------------------------------------------------------------
 
-    def _batches(self) -> Iterator[Dict]:
+    def _raw_batches(self) -> Iterator[tuple]:
+        """(host_batch, token-count info) pairs — the un-prefetched feed.
+        Token counts happen here while the arrays are host numpy."""
         c = self.config
-        batches = batches_from_instances(
-            self.reader.read(self.train_path, split="train"),
-            self.encoder,
-            batch_size=c.batch_size,
-            label_map=LABELS_BINARY,
-            pad_to_max=True,
-        )
-        for batch in prefetch(batches, depth=8):
+        instances = self.reader.read(self.train_path, split="train")
+        if self.train_buckets is None:
+            batches = batches_from_instances(
+                instances,
+                self.encoder,
+                batch_size=c.batch_size,
+                label_map=LABELS_BINARY,
+                pad_to_max=True,
+            )
+        else:
+            batches = bucketed_batches_from_instances(
+                instances,
+                self.encoder,
+                batch_size=c.batch_size,
+                label_map=LABELS_BINARY,
+                buckets=self.train_buckets,
+            )
+        for batch in batches:
             batch.pop("meta", None)
-            if self.mesh is not None:
-                batch = shard_batch(batch, self.mesh)
-            yield batch
+            info = {
+                "padded_tokens": int(batch["sample1"]["input_ids"].size),
+                "real_tokens": int(batch["sample1"]["attention_mask"].sum()),
+            }
+            yield batch, info
+
+    def _commit_batch(self, item: tuple) -> tuple:
+        """H2D commit on the prefetch worker (double-buffered feed)."""
+        batch, info = item
+        if self.mesh is not None:
+            return shard_batch(batch, self.mesh), info
+        return jax.device_put(batch), info
+
+    def _batches(self) -> Iterator[tuple]:
+        c = self.config
+        tel = get_registry()
+        return prefetch(
+            self._raw_batches(),
+            depth=int(c.prefetch_depth),
+            commit=self._commit_batch,
+            occupancy=tel.gauge("train.feed_occupancy") if tel.enabled else None,
+        )
 
     # -- epochs --------------------------------------------------------------
 
@@ -212,7 +264,8 @@ class ClassifierTrainer:
         grad_norms: List[float] = []
         pending: List[Dict] = []
         timer = StepTimer()
-        tokens_per_batch = 0
+        padded_tokens = 0  # varies per batch under bucketed collation
+        real_tokens = 0
         started = time.perf_counter()
 
         def drain() -> None:
@@ -239,11 +292,11 @@ class ClassifierTrainer:
             tel.heartbeat()
 
         with tel.span("train_epoch", epoch=self.epoch):
-            for i, batch in enumerate(self._batches()):
+            for i, (batch, info) in enumerate(self._batches()):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
-                if not tokens_per_batch:
-                    tokens_per_batch = int(batch["sample1"]["input_ids"].size)
+                padded_tokens += info["padded_tokens"]
+                real_tokens += info["real_tokens"]
                 with timer.step():
                     self.params, self.opt_state, self.rng, stats = self._step_fn(
                         self.params, self.opt_state, self.rng, batch
@@ -260,8 +313,12 @@ class ClassifierTrainer:
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started
         metrics["num_steps"] = len(losses)
-        tokens_total = tokens_per_batch * len(losses)
-        metrics["tokens_per_sec"] = tokens_total / max(
+        metrics["padded_tokens"] = padded_tokens
+        metrics["real_tokens"] = real_tokens
+        metrics["tokens_per_sec"] = padded_tokens / max(
+            metrics["epoch_seconds"], 1e-9
+        )
+        metrics["real_tokens_per_sec"] = real_tokens / max(
             metrics["epoch_seconds"], 1e-9
         )
         metrics.update(timer.summary())
@@ -271,8 +328,12 @@ class ClassifierTrainer:
             step_hist = tel.histogram("train.step_s")
             for d in timer.durations:
                 step_hist.observe(d)
-            tel.counter("train.tokens").inc(tokens_total)
+            tel.counter("train.tokens").inc(padded_tokens)
+            tel.counter("train.tokens_real").inc(real_tokens)
             tel.gauge("train.tokens_per_sec").set(metrics["tokens_per_sec"])
+            tel.gauge("train.real_tokens_per_sec").set(
+                metrics["real_tokens_per_sec"]
+            )
             tel.event(
                 "train_epoch",
                 epoch=self.epoch,

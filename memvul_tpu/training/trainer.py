@@ -39,10 +39,17 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ..data.batching import LABELS_SIAMESE, CachedEncoder, batches_from_instances, prefetch
+from ..data.batching import (
+    LABELS_SIAMESE,
+    CachedEncoder,
+    batches_from_instances,
+    bucketed_pair_batches_from_instances,
+    prefetch,
+    resolve_train_buckets,
+)
 from ..data.readers import MemoryReader
 from ..models.memory import MemoryModel, pair_loss
-from ..parallel.mesh import replicate, shard_batch
+from ..parallel.mesh import DATA_AXIS, replicate, shard_batch
 from ..resilience import faults
 from ..resilience.io import atomic_write_text
 from ..telemetry import get_registry
@@ -102,6 +109,9 @@ def make_train_step(model: MemoryModel, tx, ema_decay: Optional[float] = None):
                 microbatch["sample2"],
                 deterministic=False,
                 rngs={"dropout": rng},
+                # deduped batches carry the [B] gather map; tower-2 then
+                # encodes only the unique sample2 rows (models/memory.py)
+                sample2_index=microbatch.get("sample2_index"),
             )
         with jax.named_scope("pair_loss"):
             loss = pair_loss(
@@ -187,6 +197,24 @@ class TrainerConfig:
     batch_size: int = 32
     grad_accum: int = 2
     max_length: int = 256
+    # length-binned TRAIN collation (docs/training_throughput.md):
+    # "pow2" (default) derives power-of-two buckets up to max_length;
+    # an explicit list is validated for max_length coverage; None keeps
+    # the pre-bucketing pad-to-max collation (the microbench baseline).
+    # Pairs route to (len1, len2) grid cells, so short sides stop paying
+    # max_length BERT FLOPs; the compiled-program count stays bounded by
+    # the grid (pinned via the train_trace_count probe)
+    train_buckets: Union[str, Sequence[int], None] = "pow2"
+    # in-batch anchor deduplication: encode only the UNIQUE sample2 rows
+    # of each batch and gather the embeddings back per pair — the ~129
+    # anchor texts and same-CWE CVE descriptions repeat heavily, so
+    # tower-2 drops from B rows to U ≤ unique texts.  Only applies to
+    # the bucketed collation
+    dedup_anchors: bool = True
+    # host-side feed queue depth: collation AND the committed H2D
+    # device_put run this many batches ahead of the step on the prefetch
+    # worker (the double-buffered device feed; ≥ 1)
+    prefetch_depth: int = 8
     eval_batch_size: int = 512
     eval_max_length: int = 512
     # length-binned validation batching (same mechanism as the evaluation
@@ -269,6 +297,19 @@ class MemoryTrainer:
 
         c = self.config
         self.encoder = CachedEncoder(tokenizer, max_length=c.max_length)
+        if int(c.prefetch_depth) < 1:
+            raise ValueError(
+                f"prefetch_depth must be >= 1, got {c.prefetch_depth} "
+                "(1 = no read-ahead; 0 would deadlock the feed queue)"
+            )
+        # resolved once: "pow2" → derived grid, list → coverage-validated,
+        # None → pad-to-max legacy collation
+        self.train_buckets = resolve_train_buckets(c.train_buckets, c.max_length)
+        # under a data-sharded mesh every device-fed dimension must divide
+        # the axis — raise the dedup capacity ladder's floor to it
+        self._dedup_cap_floor = 8
+        if mesh is not None and DATA_AXIS in mesh.axis_names:
+            self._dedup_cap_floor = max(8, int(mesh.shape[DATA_AXIS]))
         total_steps = c.total_steps
         if total_steps is None and c.steps_per_epoch is not None:
             # the reference wires total steps as epochs × steps-per-epoch so
@@ -369,39 +410,86 @@ class MemoryTrainer:
             )
         return iter(self._frozen_instances)
 
-    def _microbatch_stacks(self) -> Iterator[Dict]:
-        """Group the epoch's pair stream into [K, B, L] stacks."""
+    def _microbatch_stacks(self) -> Iterator[tuple]:
+        """Group the epoch's pair stream into [K, B, L] stacks.
+
+        Bucketed mode (``train_buckets`` set) collates through the
+        (len1, len2) grid; a [K, B, ...] stack needs K identically-shaped
+        microbatches, so each shape key accumulates its own pending group
+        and epoch-end tails are padded with zero-weight copies (the same
+        dead-microbatch trick the pad-to-max path always used).  Emission
+        order is a pure function of the epoch's instance stream — what
+        keeps PR 2's mid-epoch resume replay exact under bucketing.
+
+        Yields ``(host_stack, info)`` with the stack's padded/real token
+        counts, computed HERE while the arrays are still host numpy (the
+        feed commits them to device right after — counting later would
+        mean a device→host sync on the step path).
+        """
         c = self.config
-        batches = batches_from_instances(
-            self._train_instances(),
-            self.encoder,
-            batch_size=c.batch_size,
-            label_map=LABELS_SIAMESE,
-            pad_to_max=True,  # single shape → single compiled program
-        )
-        group: List[Dict] = []
-        for batch in prefetch(batches, depth=8):
+        if self.train_buckets is None:
+            batches = batches_from_instances(
+                self._train_instances(),
+                self.encoder,
+                batch_size=c.batch_size,
+                label_map=LABELS_SIAMESE,
+                pad_to_max=True,  # single shape → single compiled program
+            )
+        else:
+            batches = bucketed_pair_batches_from_instances(
+                self._train_instances(),
+                self.encoder,
+                batch_size=c.batch_size,
+                label_map=LABELS_SIAMESE,
+                buckets=self.train_buckets,
+                dedup_side2=c.dedup_anchors,
+                dedup_cap_floor=self._dedup_cap_floor,
+            )
+        groups: Dict[tuple, List[Dict]] = {}
+        for batch in batches:
             batch.pop("meta", None)
+            key = (
+                batch["sample1"]["input_ids"].shape,
+                batch["sample2"]["input_ids"].shape,
+            )
+            group = groups.setdefault(key, [])
             group.append(batch)
             if len(group) == c.grad_accum:
                 yield self._stack(group)
-                group = []
-        if group:
-            # pad the final ragged group with zero-weight copies
+                groups[key] = []
+        # flush ragged tails in first-seen key order (dict insertion
+        # order — deterministic for a given stream)
+        for group in groups.values():
+            if not group:
+                continue
             while len(group) < c.grad_accum:
                 dead = jax.tree_util.tree_map(np.copy, group[-1])
                 dead["weight"] = np.zeros_like(dead["weight"])
                 group.append(dead)
             yield self._stack(group)
 
-    def _stack(self, group: List[Dict]) -> Dict:
+    def _stack(self, group: List[Dict]) -> tuple:
+        padded = real = 0
+        for b in group:
+            for side in ("sample1", "sample2"):
+                padded += int(b[side]["input_ids"].size)
+                real += int(b[side]["attention_mask"].sum())
         stacked = jax.tree_util.tree_map(
             lambda *xs: np.stack(xs, axis=0), *group
         )
+        return stacked, {"padded_tokens": padded, "real_tokens": real}
+
+    def _commit_stack(self, item: tuple) -> tuple:
+        """H2D commit, run on the prefetch worker so the transfer of
+        stack N+1 overlaps step N (the double-buffered device feed).
+        Under a mesh this is the sharded put the step loop used to do
+        inline; donation is untouched (the stack argument is never in
+        the step's donate_argnums)."""
+        stack, info = item
         if self.mesh is not None:
             # shard the batch dim (axis 1 of the [K, B, ...] stack)
-            stacked = shard_batch(stacked, self.mesh, batch_axis=1)
-        return stacked
+            return shard_batch(stack, self.mesh, batch_axis=1), info
+        return jax.device_put(stack), info
 
     # -- epoch orchestration ---------------------------------------------------
 
@@ -456,7 +544,8 @@ class MemoryTrainer:
         grad_norms: List[float] = []
         pending: List[Dict] = []
         timer = StepTimer()
-        tokens_per_stack = 0  # constant across the epoch (pad_to_max)
+        padded_tokens = 0  # varies per stack under bucketed collation
+        real_tokens = 0
         started = time.perf_counter()
         trace_dir = c.profile_dir if (c.profile_dir and self.epoch == 0) else None
         # mid-epoch resume: the epoch's stream is replayed from its
@@ -466,14 +555,23 @@ class MemoryTrainer:
         skip = self._resume_skip_stacks
         self._resume_skip_stacks = 0
         self._epoch_stacks_done = skip
+        # the double-buffered feed: the worker collates AND device-commits
+        # up to prefetch_depth stacks ahead of the running step; the gauge
+        # makes feed stalls visible (0 = host-bound, depth = device-bound)
+        feed = prefetch(
+            self._microbatch_stacks(),
+            depth=int(c.prefetch_depth),
+            commit=self._commit_stack,
+            occupancy=tel.gauge("train.feed_occupancy") if tel.enabled else None,
+        )
         with tel.span("train_epoch", epoch=self.epoch), trace_context(trace_dir):
-            for i, stack in enumerate(self._microbatch_stacks()):
+            for i, (stack, info) in enumerate(feed):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
                 if i < skip:
                     continue
-                if not tokens_per_stack:
-                    tokens_per_stack = int(stack["sample1"]["input_ids"].size)
+                padded_tokens += info["padded_tokens"]
+                real_tokens += info["real_tokens"]
                 # chaos hook: "step.<global step index>" fires at the
                 # start of the step (docs/fault_tolerance.md)
                 faults.fault_point(f"step.{self.step}")
@@ -524,8 +622,17 @@ class MemoryTrainer:
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started
         metrics["num_steps"] = len(losses)
-        tokens_total = tokens_per_stack * len(losses)
-        metrics["tokens_per_sec"] = tokens_total / max(
+        # padded tokens = what the device computed over (the cost);
+        # real tokens = what the corpus contained (the work).  Their gap
+        # is the padding waste the bucketed collation exists to cut —
+        # both throughputs surface so the microbench and epoch metrics
+        # tell the same story (docs/training_throughput.md)
+        metrics["padded_tokens"] = padded_tokens
+        metrics["real_tokens"] = real_tokens
+        metrics["tokens_per_sec"] = padded_tokens / max(
+            metrics["epoch_seconds"], 1e-9
+        )
+        metrics["real_tokens_per_sec"] = real_tokens / max(
             metrics["epoch_seconds"], 1e-9
         )
         metrics.update(timer.summary())
@@ -538,8 +645,12 @@ class MemoryTrainer:
             step_hist = tel.histogram("train.step_s")
             for d in timer.durations:
                 step_hist.observe(d)
-            tel.counter("train.tokens").inc(tokens_total)
+            tel.counter("train.tokens").inc(padded_tokens)
+            tel.counter("train.tokens_real").inc(real_tokens)
             tel.gauge("train.tokens_per_sec").set(metrics["tokens_per_sec"])
+            tel.gauge("train.real_tokens_per_sec").set(
+                metrics["real_tokens_per_sec"]
+            )
             tel.event(
                 "train_epoch",
                 epoch=self.epoch,

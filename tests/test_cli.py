@@ -643,8 +643,15 @@ def test_online_resample_off_freezes_pairs(ws, tmp_path):
         train_path=config["train_data_path"],
         config=TrainerConfig(**{**config["trainer"], "online_resample": False}),
     )
-    first = [np.asarray(s["sample1"]["input_ids"]) for s in trainer._microbatch_stacks()]
-    second = [np.asarray(s["sample1"]["input_ids"]) for s in trainer._microbatch_stacks()]
+    # _microbatch_stacks yields (host_stack, token-count info) pairs
+    first = [
+        np.asarray(s["sample1"]["input_ids"])
+        for s, _ in trainer._microbatch_stacks()
+    ]
+    second = [
+        np.asarray(s["sample1"]["input_ids"])
+        for s, _ in trainer._microbatch_stacks()
+    ]
     assert len(first) == len(second)
     for a, b in zip(first, second):
         np.testing.assert_array_equal(a, b)

@@ -249,6 +249,9 @@ def test_trainer_debug_checks_clean_run(ws, tmp_path):
     assert np.isfinite(result["history"][0]["training_loss"])
 
 
+@pytest.mark.slow  # the checkify-instrumented BERT step compile is ~47 s
+# on the tier-1 host; the fast variant below pins the same jit_step
+# mechanism (localization + no-donation) without the instrumented compile
 def test_trainer_debug_checks_localizes_nan(ws, tmp_path):
     """Poisoned params must raise at the offending step with checkify's
     localization (the NaN guard in _drain_stats only detects, N steps
@@ -275,6 +278,34 @@ def test_trainer_debug_checks_localizes_nan(ws, tmp_path):
         if jnp.issubdtype(l.dtype, jnp.floating)
     ]
     assert post and bool(jnp.isnan(post[0]).all())
+
+
+def test_jit_step_debug_checks_localize_and_no_donation_fast():
+    """Fast tier-1 coverage of the checkify contract: jit_step's debug
+    mode raises at the first NaN-producing op and must NOT donate its
+    inputs (the pre-step state stays inspectable post-mortem).  jit_step
+    is the ONE shared implementation behind MemoryTrainer /
+    ClassifierTrainer / MLMTrainer, so pinning it here keeps the
+    mechanism in the fast tier while the instrumented-BERT e2e variants
+    are @slow."""
+    from jax.experimental import checkify
+
+    from memvul_tpu.training.trainer import jit_step
+
+    def raw(x, y):
+        return jnp.log(x) + y.sum()  # log of a negative → nan
+
+    checked = jit_step(raw, donate=(0, 1), debug_checks=True)
+    x = jnp.asarray(-1.0)
+    y = jnp.ones(4)
+    with pytest.raises(checkify.JaxRuntimeError, match="nan"):
+        checked(x, y)
+    # debug mode must not donate: both inputs are still alive/readable
+    assert float(x) == -1.0
+    assert float(y.sum()) == 4.0
+    # the same wiring WITHOUT debug_checks donates and runs clean
+    donating = jit_step(raw, donate=(0,), debug_checks=False)
+    assert float(donating(jnp.asarray(1.0), y)) == pytest.approx(4.0)
 
 
 def test_metric_tracker_minimize_stores_raw_value():
