@@ -315,6 +315,7 @@ def cmd_serve(args) -> int:
             golden_file=args.golden_file,
             mesh=mesh,
             use_mesh=not args.no_mesh,
+            replicas=args.replicas,
         )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
@@ -333,6 +334,7 @@ def cmd_serve(args) -> int:
     print(json.dumps({
         "serving": f"http://{bound_host}:{bound_port}",
         "pid": os.getpid(),
+        "replicas": len(getattr(service, "replicas", ())) or 1,
     }))
     sys.stdout.flush()
     try:
@@ -521,12 +523,19 @@ def build_parser() -> argparse.ArgumentParser:
         "serve",
         help="online scoring service over an archived model: micro-"
         "batched, AOT-warmed, stdlib HTTP front end (POST /score, GET "
-        "/healthz), graceful SIGTERM drain (docs/serving.md)",
+        "/healthz), graceful SIGTERM drain; --replicas N runs a health-"
+        "gated multi-replica router, one service per local device "
+        "(docs/serving.md)",
     )
     p.add_argument("archive", help="model.tar.gz or its serialization dir")
     p.add_argument("-o", "--out-dir", default=None,
                    help="run dir for telemetry sinks + the anchor-bank "
-                   "manifest (default: no sinks)")
+                   "manifest (default: no sinks; replicas write "
+                   "replica-<i>/ subdirs)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="scoring services behind the router, one per "
+                   "local device round-robin (default: the archive's "
+                   "serving.replicas, 1 = no router)")
     p.add_argument("--overrides", default=None,
                    help="JSON deep-merged onto the archived config "
                    '(e.g. \'{"serving": {"max_batch": 32}}\')')

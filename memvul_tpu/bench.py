@@ -631,6 +631,14 @@ def _run_serve_micro() -> None:
     would be written against.  BENCH_MODEL=tiny exercises the full path
     off-TPU in seconds; the recorded number is only meaningful at base
     geometry on hardware.
+
+    Router mode (BENCH_SERVE_REPLICAS > 1): the same load drives a
+    :class:`~memvul_tpu.serving.ReplicaRouter` over that many replica
+    services through the SLO harness (serving/loadgen.py) —
+    BENCH_SERVE_PATTERN picks the arrival process (closed, poisson,
+    burst, diurnal, slowloris; BENCH_SERVE_RPS the open-loop rate) —
+    and the record gains per-cause shed/error counts, per-replica
+    utilization, and the fleet-wide counter invariant.
     """
     import queue as _queue
 
@@ -655,6 +663,7 @@ def _run_serve_micro() -> None:
     max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "16"))
     max_wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "5"))
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", "512"))
+    n_replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", "1"))
     n_anchors = 129
 
     with watchdog.phase("workspace"):
@@ -690,10 +699,6 @@ def _run_serve_micro() -> None:
         texts = texts + texts
     texts = texts[:n_requests]
 
-    predictor = SiamesePredictor(
-        model, params, ws["tokenizer"],
-        batch_size=max_batch, max_length=seq_len, buckets=buckets,
-    )
     base_anchors = list(ws["anchors"].items())
     anchor_instances = [
         {
@@ -703,17 +708,31 @@ def _run_serve_micro() -> None:
         }
         for i in range(n_anchors)
     ]
-    with watchdog.phase("anchor_encode"):
-        predictor.encode_anchors(anchor_instances)
-
-    service = ScoringService(
-        predictor,
-        config=ServiceConfig(
-            max_batch=max_batch, max_wait_ms=max_wait_ms,
-            max_queue=max(256, 2 * n_clients * max_batch),
-            default_deadline_ms=0.0,  # measure latency, don't shed it
-        ),
+    service_config = ServiceConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=max(256, 2 * n_clients * max_batch),
+        default_deadline_ms=0.0,  # measure latency, don't shed it
     )
+
+    def build_service(registry=None) -> ScoringService:
+        predictor = SiamesePredictor(
+            model, params, ws["tokenizer"],
+            batch_size=max_batch, max_length=seq_len, buckets=buckets,
+        )
+        predictor.encode_anchors(anchor_instances)
+        return ScoringService(predictor, config=service_config, registry=registry)
+
+    if n_replicas > 1:
+        _run_serve_router_micro(
+            watchdog, build_service, texts,
+            n_requests=n_requests, n_clients=n_clients,
+            n_replicas=n_replicas, seq_len=seq_len, buckets=buckets,
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+        )
+        return
+
+    with watchdog.phase("anchor_encode"):
+        service = build_service()
     client = InprocessClient(service)
     work: "_queue.SimpleQueue" = _queue.SimpleQueue()
     for text in texts:
@@ -774,6 +793,93 @@ def _run_serve_micro() -> None:
                     "buckets": list(buckets),
                     "requests": n_requests,
                     "clients": n_clients,
+                    "max_batch": max_batch,
+                    "max_wait_ms": max_wait_ms,
+                },
+            }
+        )
+    )
+
+
+def _run_serve_router_micro(
+    watchdog, build_service, texts, *, n_requests, n_clients, n_replicas,
+    seq_len, buckets, max_batch, max_wait_ms,
+) -> None:
+    """The router leg of BENCH_MICRO=serve (docs/serving.md, "SLO
+    harness"): N replica services behind a :class:`ReplicaRouter`,
+    driven by the deterministic load generator, reported as one JSON
+    record with per-cause outcome counts and per-replica utilization.
+    CPU-runnable at tiny geometry; the recorded rps is only meaningful
+    at base geometry on hardware (ROADMAP chip-window item)."""
+    from memvul_tpu.serving import (
+        LoadConfig,
+        Replica,
+        ReplicaRouter,
+        RouterConfig,
+        run_slo_harness,
+    )
+    from memvul_tpu.telemetry.registry import TelemetryRegistry
+
+    pattern = os.environ.get("BENCH_SERVE_PATTERN", "closed")
+    rps = float(os.environ.get("BENCH_SERVE_RPS", "200"))
+    with watchdog.phase("replica_warmup"):
+        replicas = [
+            Replica(i, lambda registry: build_service(registry=registry),
+                    telemetry_enabled=True)
+            for i in range(n_replicas)
+        ]
+    router = ReplicaRouter(
+        replicas, config=RouterConfig(),
+        registry=TelemetryRegistry(enabled=True),
+    )
+    load = LoadConfig(
+        pattern=pattern, requests=n_requests, clients=n_clients, rps=rps,
+        deadline_ms=None if pattern != "slowloris" else 60_000.0,
+    )
+    with watchdog.phase("serve_warmup"):
+        router.submit(texts[0], deadline_ms=0).result(timeout=120)
+    with watchdog.phase("serve_load"):
+        record = run_slo_harness(router, texts, config=load)
+    router.drain()
+
+    report = record["load"]
+    fleet = record.get("fleet", {})
+    print(
+        json.dumps(
+            {
+                "metric": "serve_router_microbench",
+                "value": report["achieved_rps"],
+                "unit": "requests/sec",
+                "vs_baseline": 0.0,  # no router baseline exists (BASELINE.md)
+                "latency_ms": report["latency_ms"],
+                "outcomes": report["outcomes"],  # per-cause ok/shed/deadline/...
+                "offered_rps": report["offered_rps"],
+                "duration_s": report["duration_s"],
+                "fleet": {
+                    "invariant_ok": fleet.get("invariant_ok"),
+                    "served_total": fleet.get("served_total"),
+                    "replicas": [
+                        {
+                            "name": member["name"],
+                            "served": member["served"],
+                            "shed": member["shed"],
+                            "errors": member["errors"],
+                            "restarts": member["restarts"],
+                            "utilization": member["utilization"],
+                        }
+                        for member in fleet.get("replicas", [])
+                    ],
+                },
+                "router": record.get("router", {}),
+                "config": {
+                    "model": os.environ.get("BENCH_MODEL", "base"),
+                    "seq_len": seq_len,
+                    "buckets": list(buckets),
+                    "requests": n_requests,
+                    "clients": n_clients,
+                    "replicas": n_replicas,
+                    "pattern": pattern,
+                    "rps": rps,
                     "max_batch": max_batch,
                     "max_wait_ms": max_wait_ms,
                 },

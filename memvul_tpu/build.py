@@ -316,24 +316,42 @@ def serve_from_archive(
     golden_file: Optional[Union[str, Path]] = None,
     mesh=None,
     use_mesh: bool = False,
+    replicas: Optional[int] = None,
 ):
-    """Build a ready :class:`~memvul_tpu.serving.ScoringService` from a
-    model archive (docs/serving.md).
+    """Build a ready :class:`~memvul_tpu.serving.ScoringService` — or,
+    with ``replicas > 1`` (argument or the archive's
+    ``serving.replicas``), a :class:`~memvul_tpu.serving.ReplicaRouter`
+    over that many services — from a model archive (docs/serving.md).
 
     The archive's ``serving`` section (config.SERVING_DEFAULTS) sizes
     the online predictor — ``max_batch`` is its batch shape, so the AOT
     warmup precompiles exactly the shapes the micro-batcher will
     dispatch — and the service's admission-control envelope.  With
     ``out_dir`` set, telemetry sinks and the versioned anchor-bank
-    manifest land there; the caller owns the registry's ``close()``
-    (the CLI closes it after the drain)."""
+    manifest land there (per replica in ``replica-<i>/`` subdirs for a
+    fleet); the caller owns the registry's ``close()`` (the CLI closes
+    it after the drain).
+
+    Replica fan-out places one predictor per local device (round-robin
+    over ``jax.local_devices()`` — on a multi-host job each host runs
+    its own fleet over its own devices, the
+    ``parallel/multihost.py`` enumeration); each replica re-encodes the
+    anchor bank onto its device and AOT-warms its own shapes via its
+    service factory, which the router also uses to *restart* a failed
+    replica."""
     from . import telemetry
     from .archive import load_archive
     from .config import serving_config, telemetry_config
     from .data.batching import validate_buckets
     from .evaluate.predict_memory import SiamesePredictor
     from .resilience.retry import RetryPolicy
-    from .serving import ScoringService, ServiceConfig
+    from .serving import (
+        Replica,
+        ReplicaRouter,
+        RouterConfig,
+        ScoringService,
+        ServiceConfig,
+    )
 
     arch = load_archive(archive_path, overrides=overrides)
     model_cfg = arch.config.get("model") or {}
@@ -374,38 +392,110 @@ def serve_from_archive(
         )
     if buckets is not None:
         buckets = validate_buckets([int(b) for b in buckets], max_length)
-    if mesh is None and use_mesh and len(jax.devices()) > 1:
-        from .parallel.mesh import create_mesh
-
-        mesh = create_mesh()
-    predictor = SiamesePredictor(
-        arch.model,
-        arch.params,
-        arch.tokenizer,
-        mesh=mesh,
-        batch_size=int(serve_cfg["max_batch"]),
-        max_length=max_length,
-        buckets=buckets,
-        aot_warmup=True,  # the whole point: no mid-serve compiles
-    )
     reader = build_reader(arch.config.get("dataset_reader"))
     golden = golden_file or (
         arch.config.get("dataset_reader") or {}
     ).get("anchor_path")
     if golden is None:
         raise ValueError("serving needs a golden anchor file")
-    predictor.encode_anchors(reader.read_anchors(str(golden)))
+    anchors = list(reader.read_anchors(str(golden)))
     retries = int(serve_cfg["retries"])
-    return ScoringService(
-        predictor,
-        config=ServiceConfig(
-            max_batch=int(serve_cfg["max_batch"]),
-            max_wait_ms=float(serve_cfg["max_wait_ms"]),
-            max_queue=int(serve_cfg["max_queue"]),
-            default_deadline_ms=float(serve_cfg["default_deadline_ms"]),
+    retry_policy = RetryPolicy(attempts=retries) if retries > 0 else None
+    service_config = ServiceConfig(
+        max_batch=int(serve_cfg["max_batch"]),
+        max_wait_ms=float(serve_cfg["max_wait_ms"]),
+        max_queue=int(serve_cfg["max_queue"]),
+        default_deadline_ms=float(serve_cfg["default_deadline_ms"]),
+    )
+    n_replicas = int(
+        serve_cfg["replicas"] if replicas is None else replicas
+    )
+
+    if n_replicas <= 1:
+        if mesh is None and use_mesh and len(jax.devices()) > 1:
+            from .parallel.mesh import create_mesh
+
+            mesh = create_mesh()
+        predictor = SiamesePredictor(
+            arch.model,
+            arch.params,
+            arch.tokenizer,
+            mesh=mesh,
+            batch_size=int(serve_cfg["max_batch"]),
+            max_length=max_length,
+            buckets=buckets,
+            aot_warmup=True,  # the whole point: no mid-serve compiles
+        )
+        predictor.encode_anchors(anchors)
+        return ScoringService(
+            predictor,
+            config=service_config,
+            retry_policy=retry_policy,
+            manifest_dir=out_dir,
+        )
+
+    # -- replica fan-out: one service per assigned local device ------------
+    if mesh is not None:
+        raise ValueError(
+            "--mesh shards ONE service across devices; replicas > 1 runs "
+            "one service PER device — pick one scaling axis"
+        )
+    devices = jax.local_devices()
+
+    def make_factory(index: int):
+        device = devices[index % len(devices)]
+
+        def factory(registry):
+            # commit this replica's weights to its device: every dispatch
+            # (and its compiled programs) follows the committed params
+            params = jax.device_put(arch.params, device)
+            predictor = SiamesePredictor(
+                arch.model,
+                params,
+                arch.tokenizer,
+                batch_size=int(serve_cfg["max_batch"]),
+                max_length=max_length,
+                buckets=buckets,
+                aot_warmup=True,
+            )
+            predictor.encode_anchors(anchors)
+            return ScoringService(
+                predictor,
+                config=service_config,
+                retry_policy=retry_policy,
+                manifest_dir=(
+                    Path(out_dir) / f"replica-{index}"
+                    if out_dir is not None else None
+                ),
+                registry=registry,
+            )
+
+        return factory
+
+    replica_list = [
+        Replica(
+            i,
+            make_factory(i),
+            run_dir=out_dir,
+            device=devices[i % len(devices)],
+            telemetry_enabled=bool(tel_cfg["enabled"]),
+            heartbeat_every_s=float(tel_cfg["heartbeat_every_s"]),
+        )
+        for i in range(n_replicas)
+    ]
+    logger.info(
+        "replica fleet: %d service(s) over %d local device(s)",
+        n_replicas, len(devices),
+    )
+    return ReplicaRouter(
+        replica_list,
+        config=RouterConfig(
+            heartbeat_timeout_s=float(serve_cfg["heartbeat_timeout_s"]),
+            max_batch_errors=int(serve_cfg["max_batch_errors"]),
+            monitor_interval_s=float(serve_cfg["monitor_interval_s"]),
+            max_reroutes=int(serve_cfg["max_reroutes"]),
         ),
-        retry_policy=RetryPolicy(attempts=retries) if retries > 0 else None,
-        manifest_dir=out_dir,
+        retry_policy=retry_policy,
     )
 
 

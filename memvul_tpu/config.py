@@ -322,6 +322,15 @@ SERVING_DEFAULTS: Dict[str, Any] = {
                              # corpus and is an offline-only policy)
     "host": "127.0.0.1",     # HTTP front-end bind address
     "port": 8341,            # HTTP front-end port
+    # scale-out tier (serving/router.py; docs/serving.md "Replica tier").
+    # replicas > 1 puts N ScoringServices — one per assigned local
+    # device, round-robin over jax.local_devices() — behind a
+    # ReplicaRouter; the knobs below are its health/eviction policy
+    "replicas": 1,           # ScoringService instances behind the router
+    "heartbeat_timeout_s": 10.0,  # missed-heartbeat eviction threshold
+    "max_batch_errors": 3,   # consecutive dead-letters before eviction
+    "monitor_interval_s": 0.25,  # router health-check cadence
+    "max_reroutes": 2,       # re-enqueue attempts after replica failures
 }
 
 

@@ -13,6 +13,11 @@ point              fires
 ``score.batch``    once per scoring batch, at dispatch
 ``serve.batch``    once per serving micro-batch, at dispatch (inside the
                    service's RetryPolicy window, serving/service.py)
+``replica.kill``   once per request routed to a serving replica, on its
+                   submit path (serving/replica.py) — firing it
+                   hard-kills that replica with SIGKILL semantics
+                   (nothing resolves, the router must sweep + re-route);
+                   ``replica.kill.replica-<i>`` targets one member
 ``step.N``         at the start of optimizer step ``N`` (global step index)
 ``kernel.lower``   when the fused Pallas anchor-match kernel is selected,
                    before it is traced (simulates a Mosaic lowering failure)

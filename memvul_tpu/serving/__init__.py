@@ -1,10 +1,14 @@
-"""Online scoring service: dynamic micro-batching over AOT-warmed
-shapes, admission control with per-request deadlines, graceful drain,
-and hot anchor-bank swap (docs/serving.md).
+"""Online scoring tier: dynamic micro-batching over AOT-warmed shapes,
+admission control with per-request deadlines, graceful drain, hot
+anchor-bank swap — and the scale-out layer on top: a health-gated
+multi-replica router with rolling bank swaps and a closed-loop SLO
+harness (docs/serving.md).
 
 Entry points: ``build.serve_from_archive`` constructs a ready
-:class:`ScoringService` from a model archive; ``python -m memvul_tpu
-serve`` puts the stdlib HTTP front end (serving/frontend.py) on top.
+:class:`ScoringService` (or, with ``serving.replicas > 1``, a
+:class:`ReplicaRouter` over N of them); ``python -m memvul_tpu serve
+[--replicas N]`` puts the stdlib HTTP front end (serving/frontend.py)
+on top of either.
 """
 
 from .service import (  # noqa: F401
@@ -19,3 +23,20 @@ from .service import (  # noqa: F401
     ServiceConfig,
 )
 from .client import HTTPClient, InprocessClient  # noqa: F401
+from .replica import (  # noqa: F401
+    REPLICA_DEAD,
+    REPLICA_HEALTHY,
+    REPLICA_SWAPPING,
+    REPLICA_UNHEALTHY,
+    Replica,
+    ReplicaDead,
+)
+from .router import ReplicaRouter, RouterConfig, rolling_swap  # noqa: F401
+from .loadgen import (  # noqa: F401
+    LoadConfig,
+    LoadGenerator,
+    arrival_offsets,
+    fleet_snapshot,
+    request_deadlines,
+    run_slo_harness,
+)

@@ -11,6 +11,7 @@ other.
 from __future__ import annotations
 
 import json
+import socket
 import urllib.error
 import urllib.request
 from typing import Any, Dict, Optional
@@ -42,18 +43,48 @@ class HTTPClient:
     deadline/error statuses ride HTTP 5xx), so ``score`` parses and
     returns it instead of raising — status handling stays in one place
     for both client types.
+
+    The socket timeout of a deadlined request is **derived from the
+    deadline** (``deadline_ms / 1000 + deadline_slack_s``), never the
+    flat ``timeout_s``: the server resolves an expired request at batch
+    pull, so a correct client needs only a little slack past its own
+    deadline — a fixed long timeout would leave the client parked on a
+    wedged server long after the request it sent could possibly matter.
+    A timed-out socket returns a ``"client_timeout"``-reasoned error
+    dict instead of raising, matching the non-2xx convention above.
     """
 
-    def __init__(self, base_url: str, timeout_s: float = 60.0) -> None:
+    def __init__(
+        self,
+        base_url: str,
+        timeout_s: float = 60.0,
+        deadline_slack_s: float = 5.0,
+    ) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.deadline_slack_s = deadline_slack_s
 
-    def _request(self, req: urllib.request.Request) -> Dict[str, Any]:
+    def _request(
+        self, req: urllib.request.Request, timeout_s: Optional[float] = None
+    ) -> Dict[str, Any]:
+        timeout = self.timeout_s if timeout_s is None else timeout_s
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
             return json.loads(e.read().decode("utf-8"))
+        except (TimeoutError, socket.timeout) as e:
+            return {
+                "status": "error",
+                "reason": f"client_timeout after {timeout:.3f}s: {e}",
+            }
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), (TimeoutError, socket.timeout)):
+                return {
+                    "status": "error",
+                    "reason": f"client_timeout after {timeout:.3f}s: {e.reason}",
+                }
+            raise
 
     def score(
         self, text: str, deadline_ms: Optional[float] = None
@@ -67,7 +98,12 @@ class HTTPClient:
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        return self._request(req)
+        timeout = (
+            deadline_ms / 1000.0 + self.deadline_slack_s
+            if deadline_ms and deadline_ms > 0
+            else None  # no deadline: the flat timeout_s still applies
+        )
+        return self._request(req, timeout_s=timeout)
 
     def health(self) -> Dict[str, Any]:
         return self._request(

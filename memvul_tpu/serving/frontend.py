@@ -13,8 +13,16 @@ API (JSON over ``http.server``; docs/serving.md):
   the service response (``status`` "ok" carries the per-anchor
   ``predict`` dict, best ``score``/``anchor``, and ``bank_version``).
   HTTP status: 200 ok, 503 shed/drain, 504 deadline, 500 error.
-* ``GET /healthz`` → liveness + queue depth + bank version (200, or
-  503 once draining — a load balancer's eviction signal).
+* ``GET /healthz`` → the target's ``health_summary()``: drain state,
+  queue depth, active bank version, and — behind a
+  :class:`~memvul_tpu.serving.router.ReplicaRouter` — the per-replica
+  health rows, so an external probe distinguishes "degraded fleet"
+  from "healthy".  HTTP 200, or 503 once draining (a load balancer's
+  eviction signal — that contract is unchanged).
+
+The front end serves a single :class:`ScoringService` or a
+:class:`ReplicaRouter` interchangeably: both expose ``submit`` /
+``health_summary`` / ``default_deadline_ms``.
 
 The access log goes through ``logging`` (never print — the bare-print
 lint holds for serving code too).
@@ -86,13 +94,8 @@ class ScoreHandler(BaseHTTPRequestHandler):
         if self.path != "/healthz":
             self._reply(404, {"status": "error", "reason": "unknown path"})
             return
-        service = self.server.service
-        draining = service._draining.is_set()
-        self._reply(503 if draining else 200, {
-            "status": "draining" if draining else "ok",
-            "queue_depth": service.queue_depth,
-            "bank_version": service.bank_version,
-        })
+        summary = self.server.service.health_summary()
+        self._reply(503 if summary["draining"] else 200, summary)
 
     def do_POST(self) -> None:
         if self.path != "/score":
@@ -120,7 +123,7 @@ class ScoreHandler(BaseHTTPRequestHandler):
         wait_s = _RESULT_SLACK_S + (
             deadline_ms / 1000.0
             if deadline_ms and deadline_ms > 0
-            else service.config.default_deadline_ms / 1000.0
+            else service.default_deadline_ms / 1000.0
         )
         try:
             response = future.result(timeout=wait_s)

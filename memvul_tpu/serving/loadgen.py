@@ -1,0 +1,323 @@
+"""Load generation + the SLO regression harness for the serving tier.
+
+A serving stack is only as good as the traffic it was proven under.
+This module generates **deterministic, realistic arrival processes**
+(seeded; two runs of the same config submit the same schedule) and
+turns one run into a parseable SLO record — the thing
+``BENCH_MICRO=serve``'s router mode emits and the regression tests pin
+(docs/serving.md, "SLO harness"):
+
+* ``closed`` — N client threads in submit→wait lockstep (the classic
+  closed loop: measures the service at its own pace);
+* ``poisson`` — open-loop steady state: exponential inter-arrivals at
+  a target rate, the memoryless baseline SLOs are written against;
+* ``burst`` — on/off traffic: whole bursts land at once separated by
+  idle gaps (the retry-storm / thundering-herd shape);
+* ``diurnal`` — the arrival rate ramps sinusoidally between a floor
+  and the peak over a configurable period (a day compressed into
+  seconds for tests);
+* ``slowloris`` — poisson plus a fraction of *deadline abusers*:
+  requests carrying near-zero deadlines that are admitted, queue, and
+  then shed — capacity held briefly and returned, the admission-
+  control pressure a public endpoint actually sees.
+
+The report sums outcomes **per cause** (ok / shed / deadline / drain /
+error / hang) and asserts the one number that must always be zero:
+``hang`` — a request whose future never resolved inside the collection
+timeout.  :func:`run_slo_harness` folds in the fleet view (per-replica
+served/shed/errors + utilization from each replica's own registry, the
+router's counters, and the fleet-wide counter invariant) so one JSON
+record answers both "how fast" and "did anything leak".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+PATTERNS = ("closed", "poisson", "burst", "diurnal", "slowloris")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadConfig:
+    """One load scenario.  All randomness comes from ``seed``."""
+
+    pattern: str = "closed"
+    requests: int = 256
+    rps: float = 200.0            # open-loop target arrival rate
+    clients: int = 4              # closed-loop concurrency
+    deadline_ms: Optional[float] = None  # per-request deadline (None = default)
+    seed: int = 0
+    burst_size: int = 32          # burst: requests landing together
+    burst_idle_s: float = 0.05    # burst: gap between bursts
+    diurnal_period_s: float = 2.0  # diurnal: one full rate cycle
+    diurnal_floor: float = 0.25   # diurnal: trough rate as a peak fraction
+    abuser_frac: float = 0.1      # slowloris: deadline-abuser fraction
+    abuser_deadline_ms: float = 1.0  # slowloris: the abusive deadline
+    result_timeout_s: float = 60.0  # future-collection bound (hang detector)
+
+    def __post_init__(self) -> None:
+        if self.pattern not in PATTERNS:
+            raise ValueError(
+                f"unknown load pattern {self.pattern!r} (known: {PATTERNS})"
+            )
+        if self.requests < 1:
+            raise ValueError("requests must be >= 1")
+
+
+def arrival_offsets(config: LoadConfig) -> List[float]:
+    """Submission times in seconds from load start — deterministic in
+    ``config`` (the regression property: a re-run replays the exact
+    schedule).  ``closed`` has no schedule (clients self-pace)."""
+    rng = random.Random(config.seed)
+    n = config.requests
+    if config.pattern == "closed":
+        return [0.0] * n
+    if config.pattern == "burst":
+        offsets: List[float] = []
+        t = 0.0
+        while len(offsets) < n:
+            offsets.extend([t] * min(config.burst_size, n - len(offsets)))
+            t += config.burst_idle_s
+        return offsets
+    if config.pattern == "diurnal":
+        # thinning-free construction: integrate a sinusoidal rate —
+        # each unit-mean exponential gap is divided by the instantaneous
+        # rate, so troughs stretch gaps and peaks compress them
+        offsets = []
+        t = 0.0
+        floor = max(0.0, min(1.0, config.diurnal_floor))
+        for _ in range(n):
+            phase = 2.0 * math.pi * (t / config.diurnal_period_s)
+            scale = floor + (1.0 - floor) * 0.5 * (1.0 - math.cos(phase))
+            rate = max(config.rps * scale, 1e-6)
+            t += rng.expovariate(1.0) / rate
+            offsets.append(t)
+        return offsets
+    # poisson and slowloris share the steady-state arrival process
+    offsets = []
+    t = 0.0
+    for _ in range(n):
+        t += rng.expovariate(max(config.rps, 1e-6))
+        offsets.append(t)
+    return offsets
+
+
+def request_deadlines(config: LoadConfig) -> List[Optional[float]]:
+    """Per-request deadlines.  Only ``slowloris`` mixes in abusers —
+    drawn from a seed derived from (but distinct from) the arrival
+    seed, so schedules and abuser picks vary independently."""
+    if config.pattern != "slowloris":
+        return [config.deadline_ms] * config.requests
+    rng = random.Random(config.seed ^ 0x5105)
+    return [
+        config.abuser_deadline_ms
+        if rng.random() < config.abuser_frac
+        else config.deadline_ms
+        for _ in range(config.requests)
+    ]
+
+
+def _percentile(ordered: Sequence[float], q: float) -> Optional[float]:
+    if not ordered:
+        return None
+    idx = int(round((len(ordered) - 1) * (q / 100.0)))
+    return ordered[max(0, min(idx, len(ordered) - 1))]
+
+
+class LoadGenerator:
+    """Drive a ``submit(text, deadline_ms) -> ScoreFuture`` target —
+    a :class:`ScoringService` or a :class:`ReplicaRouter` — through one
+    :class:`LoadConfig` scenario and measure it."""
+
+    def __init__(
+        self,
+        submit: Callable[..., Any],
+        config: Optional[LoadConfig] = None,
+    ) -> None:
+        self.submit = submit
+        self.config = config or LoadConfig()
+
+    def run(self, texts: Sequence[str]) -> Dict[str, Any]:
+        """Submit the scenario's requests (cycling over ``texts``) and
+        collect every outcome.  Returns the load-side SLO report."""
+        cfg = self.config
+        if not texts:
+            raise ValueError("load generation needs at least one text")
+        deadlines = request_deadlines(cfg)
+        entries: List[Dict[str, Any]] = []
+        entries_lock = threading.Lock()
+
+        def _record(i: int, t0: float, future) -> None:
+            with entries_lock:
+                entries.append({"i": i, "t0": t0, "future": future})
+
+        start = time.perf_counter()
+        if cfg.pattern == "closed":
+            cursor = iter(range(cfg.requests))
+            cursor_lock = threading.Lock()
+
+            def _client() -> None:
+                while True:
+                    with cursor_lock:
+                        i = next(cursor, None)
+                    if i is None:
+                        return
+                    t0 = time.perf_counter()
+                    future = self.submit(
+                        texts[i % len(texts)], deadline_ms=deadlines[i]
+                    )
+                    # closed loop: wait before taking the next request
+                    try:
+                        future.result(timeout=cfg.result_timeout_s)
+                    except TimeoutError:
+                        pass  # scored as a hang at collection below
+                    _record(i, t0, future)
+
+            threads = [
+                threading.Thread(target=_client, daemon=True)
+                for _ in range(max(1, cfg.clients))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            offsets = arrival_offsets(cfg)
+            for i, offset in enumerate(offsets):
+                delay = start + offset - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t0 = time.perf_counter()
+                _record(
+                    i, t0,
+                    self.submit(texts[i % len(texts)], deadline_ms=deadlines[i]),
+                )
+        submitted_span = time.perf_counter() - start
+
+        outcomes = {
+            "ok": 0, "shed": 0, "deadline": 0, "drain": 0, "error": 0,
+            "hang": 0,
+        }
+        latencies: List[float] = []
+        last_done = start
+        for entry in entries:
+            try:
+                response = entry["future"].result(timeout=cfg.result_timeout_s)
+            except TimeoutError:
+                # the one outcome that must never happen: an unresolved
+                # client — surfaces as hang > 0 in the record
+                outcomes["hang"] += 1
+                continue
+            status = response.get("status", "error")
+            outcomes[status] = outcomes.get(status, 0) + 1
+            now = time.perf_counter()
+            last_done = max(last_done, now)
+            if status == "ok":
+                latencies.append(
+                    response.get("latency_ms", (now - entry["t0"]) * 1e3)
+                )
+        duration = max(last_done - start, submitted_span, 1e-9)
+        latencies.sort()
+        report: Dict[str, Any] = {
+            "pattern": cfg.pattern,
+            "requests": cfg.requests,
+            "seed": cfg.seed,
+            "duration_s": round(duration, 4),
+            "offered_rps": (
+                round(cfg.requests / max(submitted_span, 1e-9), 2)
+                if cfg.pattern != "closed" else None
+            ),
+            "achieved_rps": round(outcomes["ok"] / duration, 2),
+            "latency_ms": {
+                "p50": _percentile(latencies, 50),
+                "p95": _percentile(latencies, 95),
+                "p99": _percentile(latencies, 99),
+                "mean": (
+                    round(sum(latencies) / len(latencies), 3)
+                    if latencies else None
+                ),
+                "max": latencies[-1] if latencies else None,
+            },
+            "outcomes": outcomes,
+        }
+        return report
+
+
+def fleet_snapshot(replicas) -> Dict[str, Any]:
+    """Per-replica counters + the fleet-wide invariant, read from each
+    replica's own registry (serving/replica.py).  The invariant —
+    ``served + shed + errors == requests`` per replica, and therefore
+    fleet-wide — is the leak detector: any request a death dropped on
+    the floor breaks the sum."""
+    members = []
+    total_served = 0
+    invariant_ok = True
+    for replica in replicas:
+        snapshot = replica.registry.snapshot()["counters"]
+        served = snapshot.get("serve.served", 0)
+        shed = snapshot.get("serve.shed", 0)
+        errors = snapshot.get("serve.errors", 0)
+        requests = snapshot.get("serve.requests", 0)
+        invariant_ok &= served + shed + errors == requests
+        total_served += served
+        members.append({
+            "name": replica.name,
+            "state": replica.state,
+            "restarts": replica.restart_count,
+            "bank_version": replica.bank_version,
+            "heartbeat_age_s": round(replica.heartbeat_age_s(), 3),
+            "requests": requests,
+            "served": served,
+            "shed": shed,
+            "shed_overflow": snapshot.get("serve.shed_overflow", 0),
+            "shed_deadline": snapshot.get("serve.shed_deadline", 0),
+            "shed_drain": snapshot.get("serve.shed_drain", 0),
+            "errors": errors,
+            "errors_lost": snapshot.get("serve.errors_lost", 0),
+        })
+    for member in members:
+        member["utilization"] = (
+            round(member["served"] / total_served, 4) if total_served else 0.0
+        )
+    return {
+        "replicas": members,
+        "served_total": total_served,
+        "invariant_ok": bool(invariant_ok),
+    }
+
+
+def run_slo_harness(
+    target,
+    texts: Sequence[str],
+    config: Optional[LoadConfig] = None,
+    replicas=None,
+    router_registry=None,
+) -> Dict[str, Any]:
+    """One SLO measurement: drive ``target`` (service or router) with a
+    load scenario and merge the client-side report with the fleet view.
+    The record is a plain JSON-able dict — ``BENCH_MICRO=serve``'s
+    router mode prints it verbatim, and the regression tests assert on
+    its fields rather than its prose."""
+    report = LoadGenerator(target.submit, config).run(texts)
+    record: Dict[str, Any] = {"load": report}
+    if replicas is None:
+        replicas = getattr(target, "replicas", None)
+    if replicas:
+        record["fleet"] = fleet_snapshot(replicas)
+    registry = router_registry or getattr(target, "_tel", None)
+    if registry is not None and hasattr(registry, "snapshot"):
+        counters = registry.snapshot()["counters"]
+        record["router"] = {
+            name.split(".", 1)[1]: value
+            for name, value in counters.items()
+            if name.startswith("router.")
+        }
+    return record

@@ -1,0 +1,283 @@
+"""One serving replica: a :class:`ScoringService` plus its own health.
+
+The scale-out tier (docs/serving.md, "Replica tier") runs N scoring
+services — one per assigned local device — behind a
+:class:`~memvul_tpu.serving.router.ReplicaRouter`.  A replica owns
+everything that makes one service individually observable and
+individually replaceable:
+
+* **its own telemetry registry** — each replica's counters, events,
+  and ``HEARTBEAT.json`` land in ``<run_dir>/replica-<i>/`` (the PR 3
+  sinks, one set per replica), so the router's health checks and the
+  fleet-wide counter invariant read per-replica state instead of a
+  process-wide blur;
+* **a service factory** — a zero-argument-but-registry closure that
+  rebuilds the service (predictor placement, anchor encode, AOT
+  warmup) so a failed replica can be *restarted*, not just evicted.
+  The registry survives restarts: counters accumulate across a
+  replica's lives, which is what keeps the fleet-wide
+  ``served + shed + errors == requests`` invariant exact through a
+  death;
+* **health self-diagnosis** — :meth:`check_health` classifies the
+  replica from its registry's liveness clock (the batcher ticks it
+  even when idle) and counter deltas: a dead batcher thread is
+  ``DEAD``, a stalled heartbeat or a run of dead-lettered batches
+  with no successes is ``UNHEALTHY``, anything else ``HEALTHY``;
+* **the ``replica.kill`` chaos point** — fired on the submit path, it
+  hard-kills this replica exactly the way a SIGKILLed worker process
+  dies: the service stops resolving anything, queued and in-flight
+  requests are left dangling, and only the supervisor's sweep
+  (:meth:`sweep_unresolved`) accounts them (``serve.errors`` +
+  ``serve.errors_lost``) so the invariant still sums.
+
+The heavy operations (restart's re-encode/warmup, bank installs) run
+on whatever thread calls them — the router deliberately calls them
+from its monitor/control paths, never from request dispatch
+(tools/lint_no_blocking_in_handler.py enforces that split).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+from ..resilience import faults
+from ..telemetry.registry import TelemetryRegistry
+from .service import ScoreFuture, ScoringService, _Request
+
+logger = logging.getLogger(__name__)
+
+# replica lifecycle states (strings so they serialize straight into
+# telemetry events and the /healthz body)
+REPLICA_STARTING = "starting"
+REPLICA_HEALTHY = "healthy"
+REPLICA_UNHEALTHY = "unhealthy"
+REPLICA_SWAPPING = "swapping"   # readmission-gated during a rolling swap
+REPLICA_DEAD = "dead"
+
+
+class ReplicaDead(RuntimeError):
+    """Raised by :meth:`Replica.submit` when the replica cannot accept —
+    the router's signal to pick another queue immediately."""
+
+
+class Replica:
+    """One scoring service + its registry, factory, and health state.
+
+    ``service_factory(registry)`` must return a started
+    :class:`ScoringService` reporting into ``registry``; it is called at
+    construction and again on every restart.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        service_factory: Callable[[TelemetryRegistry], ScoringService],
+        run_dir: Optional[Union[str, Path]] = None,
+        device: Any = None,
+        telemetry_enabled: bool = True,
+        heartbeat_every_s: float = 5.0,
+    ) -> None:
+        self.index = int(index)
+        self.name = f"replica-{self.index}"
+        self.device = device
+        self._factory = service_factory
+        self.restart_count = 0
+        self.state = REPLICA_STARTING
+        self._state_lock = threading.Lock()
+        self._restart_lock = threading.Lock()
+        # router readmission gate: cleared while a rolling swap drains
+        # this replica; the router routes only to set+healthy replicas
+        self.accepting = threading.Event()
+        # counter snapshots for the consecutive-batch-error streak
+        self._last_dead_letters = 0
+        self._last_batches = 0
+        self._err_streak = 0
+        self.registry = TelemetryRegistry(
+            run_dir=Path(run_dir) / self.name if run_dir else None,
+            enabled=telemetry_enabled,
+            heartbeat_every_s=heartbeat_every_s,
+        )
+        self.service = service_factory(self.registry)
+        self.state = REPLICA_HEALTHY
+        self.accepting.set()
+        self.registry.event("replica_start", replica=self.name)
+
+    # -- request path ----------------------------------------------------------
+
+    def submit(self, text: str, deadline_ms: Optional[float] = None) -> ScoreFuture:
+        """Enqueue on this replica's service.  Raises :class:`ReplicaDead`
+        when the replica is dead — including the moment the
+        ``replica.kill`` chaos point fires, which hard-kills this
+        replica first so the caller re-routes against a genuinely dead
+        worker, not a healthy one wearing a costume."""
+        if self.state == REPLICA_DEAD:
+            raise ReplicaDead(f"{self.name} is dead")
+        try:
+            faults.fault_point(f"replica.kill.{self.name}")
+            faults.fault_point("replica.kill")
+        except Exception as e:
+            self.kill(reason=f"injected: {e}")
+            raise ReplicaDead(f"{self.name} killed by fault injection") from e
+        return self.service.submit(text, deadline_ms=deadline_ms)
+
+    @property
+    def queue_depth(self) -> int:
+        if self.state == REPLICA_DEAD:
+            return 0
+        return self.service.queue_depth
+
+    @property
+    def bank_version(self) -> int:
+        return self.service.bank_version
+
+    def heartbeat_age_s(self) -> float:
+        return self.registry.heartbeat_age_s()
+
+    # -- death / sweep ---------------------------------------------------------
+
+    def kill(self, reason: str = "killed") -> None:
+        """Hard-kill (SIGKILL semantics): the service stops resolving,
+        nothing is drained, the state flips to DEAD.  Idempotent."""
+        with self._state_lock:
+            if self.state == REPLICA_DEAD:
+                return
+            self.state = REPLICA_DEAD
+        self.accepting.clear()
+        self.service.hard_kill()
+        self.registry.counter("replica.kills").inc()
+        self.registry.event("replica_killed", replica=self.name, reason=reason)
+        logger.warning("%s hard-killed: %s", self.name, reason)
+
+    def sweep_unresolved(self) -> List[_Request]:
+        """Collect the killed service's dangling requests and account
+        them: each was counted into ``serve.requests`` at submit but
+        will never resolve here, so the sweep books them as
+        ``serve.errors`` (+ ``serve.errors_lost`` for the cause split)
+        — the fleet-wide counter invariant survives the death.  Returns
+        the swept service-level requests (the router re-enqueues its
+        own routed-request records, not these)."""
+        pending = self.service.take_unresolved()
+        if pending:
+            self.registry.counter("serve.errors").inc(len(pending))
+            self.registry.counter("serve.errors_lost").inc(len(pending))
+            self.registry.event(
+                "replica_swept", replica=self.name, lost=len(pending)
+            )
+        return pending
+
+    # -- health ----------------------------------------------------------------
+
+    def check_health(
+        self, heartbeat_timeout_s: float, max_batch_errors: int
+    ) -> str:
+        """Classify this replica from its own telemetry (the router's
+        monitor calls this every interval):
+
+        * batcher thread gone without a drain → ``DEAD``;
+        * heartbeat age over ``heartbeat_timeout_s`` (the batcher ticks
+          even when idle, so age only grows when it is wedged) →
+          ``UNHEALTHY``;
+        * ≥ ``max_batch_errors`` dead-lettered batches since the last
+          successful one → ``UNHEALTHY``;
+        * otherwise (and on recovery of the transient causes) →
+          ``HEALTHY``.
+        """
+        with self._state_lock:
+            if self.state == REPLICA_DEAD:
+                return self.state
+            if self.state == REPLICA_SWAPPING:
+                return self.state  # the swap owns this replica right now
+            if not self.service.batcher_alive and not self.service.draining:
+                self.state = REPLICA_DEAD
+                self.accepting.clear()
+                self.registry.event(
+                    "replica_dead", replica=self.name, reason="batcher exited"
+                )
+                return self.state
+            batches = self.registry.counter("serve.batches").value
+            dead_letters = self.registry.counter("serve.dead_letters").value
+            if batches > self._last_batches:
+                self._err_streak = 0
+            self._err_streak += dead_letters - self._last_dead_letters
+            self._last_batches = batches
+            self._last_dead_letters = dead_letters
+            stalled = self.heartbeat_age_s() > heartbeat_timeout_s
+            erroring = self._err_streak >= max(1, max_batch_errors)
+            new_state = (
+                REPLICA_UNHEALTHY if (stalled or erroring) else REPLICA_HEALTHY
+            )
+            if new_state != self.state:
+                self.registry.event(
+                    "replica_state", replica=self.name,
+                    state=new_state, was=self.state,
+                    heartbeat_age_s=round(self.heartbeat_age_s(), 3),
+                    err_streak=self._err_streak,
+                )
+                self.state = new_state
+            return self.state
+
+    # -- restart / bank install ------------------------------------------------
+
+    def restart(self, drain_timeout_s: float = 5.0) -> None:
+        """Replace the service with a freshly built one (drain → build →
+        readmit).  An unhealthy replica is drained first — its queued
+        requests resolve ``"drain"`` and flow back through the router's
+        re-enqueue; a drain that cannot finish (wedged batcher) falls
+        back to a hard kill + sweep so nothing dangles.  The registry —
+        and therefore every counter — carries over."""
+        with self._restart_lock:
+            old = self.service
+            if not old.killed:
+                old.drain(timeout=drain_timeout_s)
+                if old.batcher_alive:
+                    old.hard_kill()
+            if old.killed:
+                # account anything the dead/wedged batcher abandoned
+                self.sweep_unresolved()
+            self.service = self._factory(self.registry)
+            self.restart_count += 1
+            self._err_streak = 0
+            self._last_batches = self.registry.counter("serve.batches").value
+            self._last_dead_letters = self.registry.counter(
+                "serve.dead_letters"
+            ).value
+            with self._state_lock:
+                self.state = REPLICA_HEALTHY
+            self.accepting.set()
+            self.registry.counter("replica.restarts").inc()
+            self.registry.event(
+                "replica_restart", replica=self.name, n=self.restart_count
+            )
+            logger.info("%s restarted (restart #%d)", self.name, self.restart_count)
+
+    def install_bank(
+        self, anchor_instances: Iterable[Dict], version: Optional[int] = None
+    ) -> int:
+        """Encode + pre-warm + install a bank on this replica's service
+        at an explicit fleet version (the rolling-swap step; see
+        ``ScoringService.swap_bank`` for the no-torn-snapshot story)."""
+        return self.service.swap_bank(anchor_instances, version=version)
+
+    # -- shutdown --------------------------------------------------------------
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain the service (unless already dead) and close this
+        replica's telemetry sinks."""
+        if self.state != REPLICA_DEAD:
+            self.service.drain(timeout=timeout)
+        self.registry.close()
+
+    def summary(self) -> Dict[str, Any]:
+        """One /healthz row: state, backlog, liveness, lives used."""
+        return {
+            "name": self.name,
+            "state": self.state,
+            "accepting": self.accepting.is_set(),
+            "queue_depth": self.queue_depth,
+            "heartbeat_age_s": round(self.heartbeat_age_s(), 3),
+            "restarts": self.restart_count,
+            "bank_version": self.bank_version,
+        }

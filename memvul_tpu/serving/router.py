@@ -1,0 +1,536 @@
+"""Multi-replica request router — the scale-out serving tier.
+
+PR 4's :class:`~memvul_tpu.serving.service.ScoringService` is one
+predictor on one device: a hard throughput ceiling and a single point
+of failure.  The replica tier runs N services (serving/replica.py, one
+per assigned local device; each host of a multi-host job runs its own
+fleet over ``jax.local_devices()``) behind this router, which owns the
+three fleet problems a single service never had:
+
+* **load balancing** — a routing decision reads live replica queue
+  depths and picks the least-loaded healthy, accepting replica
+  (preferring ones serving the request's pinned bank version).  That
+  is ALL a routing decision may do: the
+  ``lint_no_blocking_in_handler`` tool rejects ``predict*``/``sleep``/
+  scoring calls inside any ``*Router`` class, the same discipline the
+  HTTP handlers live under — dispatch selects a queue, every heavy
+  operation happens on a replica's own threads or the control plane;
+* **health-gated membership** — a monitor thread runs each replica's
+  :meth:`~memvul_tpu.serving.replica.Replica.check_health` (missed
+  heartbeats, repeated dead-lettered batches, a dead batcher thread),
+  evicts unhealthy replicas from routing, drains and restarts them
+  through the shared :class:`~memvul_tpu.resilience.retry.RetryPolicy`,
+  and **re-enqueues** every request a dead replica still owed onto a
+  surviving one — a client sees a retry, never a hang;
+* **rolling bank swaps** — :func:`rolling_swap` extends the single
+  service's no-torn-snapshot invariant to the fleet: each request is
+  pinned at admission to the fleet's active bank version, replicas are
+  swapped one at a time (stop routing → drain its queue → encode +
+  pre-warm + install at the NEW fleet version → readmit), and the
+  fleet version advances only after every replica serves it.  Every
+  response therefore carries exactly one bank version; a restarted
+  replica re-installs the fleet's current bank before readmission so a
+  death mid-rollout cannot resurrect the old bank.
+
+Router metrics (``router.*``) live in the process-wide registry;
+per-replica ``serve.*`` counters live in each replica's own registry —
+the fleet-wide invariant ``Σ served + Σ shed + Σ errors == Σ requests``
+is a sum over replica registries (docs/serving.md lists the names).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from ..telemetry import get_registry
+from .replica import (
+    REPLICA_DEAD,
+    REPLICA_HEALTHY,
+    REPLICA_SWAPPING,
+    REPLICA_UNHEALTHY,
+    Replica,
+    ReplicaDead,
+)
+from .service import (
+    STATUS_DEADLINE,
+    STATUS_DRAIN,
+    STATUS_ERROR,
+    STATUS_OK,
+    ScoreFuture,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Fleet-management knobs; defaults mirror ``config.SERVING_DEFAULTS``
+    (the JSON-facing view)."""
+
+    heartbeat_timeout_s: float = 10.0  # missed-heartbeat eviction threshold
+    max_batch_errors: int = 3     # consecutive dead-letters before eviction
+    monitor_interval_s: float = 0.25  # health-check cadence
+    max_reroutes: int = 2         # re-enqueue attempts after replica failures
+    auto_restart: bool = True     # restart evicted/dead replicas
+    restart_drain_timeout_s: float = 5.0
+
+
+@dataclasses.dataclass
+class _RoutedRequest:
+    """The router's own record of one client request — it outlives any
+    single replica's ``_Request`` so a death can re-enqueue it."""
+
+    rid: int
+    text: str
+    deadline_ms: Optional[float]
+    deadline_monotonic: Optional[float]
+    future: ScoreFuture
+    pinned_version: int
+    attempts: int = 0
+
+
+class ReplicaRouter:
+    """Load-balancing dispatch over a fleet of :class:`Replica`\\ s.
+
+    The public surface mirrors :class:`ScoringService` (``submit`` /
+    ``queue_depth`` / ``bank_version`` / ``draining`` /
+    ``health_summary`` / ``request_drain`` / ``drain``) so the HTTP
+    front end and the clients serve either without knowing which.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Replica],
+        config: Optional[RouterConfig] = None,
+        retry_policy=None,
+        registry=None,
+    ) -> None:
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        self.replicas: List[Replica] = list(replicas)
+        self.config = config or RouterConfig()
+        self.retry_policy = retry_policy
+        self._tel = registry if registry is not None else get_registry()
+        self._lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._rr = itertools.count()  # round-robin tie-break cursor
+        # per-replica map of routed requests awaiting their inner future
+        self._outstanding: Dict[str, Dict[int, _RoutedRequest]] = {
+            r.name: {} for r in self.replicas
+        }
+        self._draining = threading.Event()
+        self._swap_lock = threading.Lock()  # one rolling swap at a time
+        self._active_version = max(r.bank_version for r in self.replicas)
+        # the fleet's current bank content, for re-install on restart
+        # (None = the factory-built bank is still current)
+        self._bank_instances: Optional[List[Dict]] = None
+        self._default_deadline_ms = self.replicas[0].service.default_deadline_ms
+        self._recovering: Dict[str, bool] = {}
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="memvul-router-monitor", daemon=True
+        )
+        self._monitor.start()
+        self._tel.gauge("router.replicas").set(len(self.replicas))
+        self._tel.gauge("router.bank_version").set(self._active_version)
+        self._tel.event("router_start", replicas=len(self.replicas))
+
+    # -- ScoringService-compatible surface ------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.queue_depth for r in self.replicas)
+
+    @property
+    def bank_version(self) -> int:
+        return self._active_version
+
+    @property
+    def default_deadline_ms(self) -> float:
+        return self._default_deadline_ms
+
+    def health_summary(self) -> Dict[str, Any]:
+        """The /healthz body for a fleet: drain state, total backlog,
+        active bank version, and the per-replica health rows — an
+        external probe can tell "degraded fleet" (some unhealthy
+        members) from "healthy"."""
+        draining = self._draining.is_set()
+        members = [r.summary() for r in self.replicas]
+        healthy = sum(1 for m in members if m["state"] == REPLICA_HEALTHY)
+        if draining:
+            status = "draining"
+        elif healthy == len(members):
+            status = "ok"
+        elif healthy > 0:
+            status = "degraded"
+        else:
+            status = "unavailable"
+        return {
+            "status": status,
+            "draining": draining,
+            "queue_depth": self.queue_depth,
+            "bank_version": self._active_version,
+            "replicas": {
+                "total": len(members),
+                "healthy": healthy,
+                "members": members,
+            },
+        }
+
+    # -- dispatch --------------------------------------------------------------
+
+    def submit(
+        self, text: str, deadline_ms: Optional[float] = None
+    ) -> ScoreFuture:
+        """Route one request: pin it to the fleet's active bank version,
+        pick the least-loaded healthy replica, relay its response.  The
+        returned future ALWAYS resolves — via the replica, via a
+        re-route after a replica death, or via the router's own
+        deadline/drain/exhaustion terminal statuses."""
+        future = ScoreFuture()
+        self._tel.counter("router.requests").inc()
+        if self._draining.is_set():
+            self._tel.counter("router.shed_drain").inc()
+            future.resolve({"status": STATUS_DRAIN})
+            return future
+        now = time.monotonic()
+        effective_ms = (
+            self._default_deadline_ms if deadline_ms is None else deadline_ms
+        )
+        request = _RoutedRequest(
+            rid=next(self._rid),
+            text=text,
+            deadline_ms=deadline_ms,
+            deadline_monotonic=(
+                now + effective_ms / 1000.0 if effective_ms > 0 else None
+            ),
+            future=future,
+            pinned_version=self._active_version,
+        )
+        self._route(request)
+        return future
+
+    def _pick(self, request: _RoutedRequest) -> Optional[Replica]:
+        """The routing decision: among healthy, accepting replicas —
+        preferring ones serving the request's pinned bank version —
+        the smallest live queue, round-robin on ties.  Selection only;
+        nothing here may block or score (the router lint)."""
+        candidates = [
+            r for r in self.replicas
+            if r.state == REPLICA_HEALTHY and r.accepting.is_set()
+        ]
+        if not candidates:
+            return None
+        pinned = [
+            r for r in candidates if r.bank_version == request.pinned_version
+        ]
+        pool = pinned or candidates
+        offset = next(self._rr)
+        return min(
+            enumerate(pool),
+            key=lambda ir: (ir[1].queue_depth, (ir[0] + offset) % len(pool)),
+        )[1]
+
+    def _route(self, request: _RoutedRequest) -> None:
+        replica = self._pick(request)
+        if replica is None:
+            self._tel.counter("router.unroutable").inc()
+            request.future.resolve({
+                "status": STATUS_ERROR,
+                "reason": "no healthy replica to route to",
+            })
+            return
+        with self._lock:
+            self._outstanding[replica.name][request.rid] = request
+        try:
+            inner = replica.submit(
+                request.text, deadline_ms=self._remaining_ms(request)
+            )
+        except ReplicaDead:
+            with self._lock:
+                self._outstanding[replica.name].pop(request.rid, None)
+            self._reroute(request, reason=f"{replica.name} died at submit")
+            return
+        self._tel.counter("router.routed").inc()
+        inner.add_done_callback(
+            lambda response, request=request, replica=replica: self._on_inner(
+                request, replica, response
+            )
+        )
+
+    def _remaining_ms(self, request: _RoutedRequest) -> Optional[float]:
+        """The deadline budget left for a (re-)submission.  Explicit 0
+        and unlimited requests stay unlimited; everything else hands the
+        replica the original absolute deadline, not a fresh window."""
+        if request.deadline_monotonic is None:
+            # deadline_ms was 0/negative (explicitly unlimited) or the
+            # default resolved to unlimited — keep it that way
+            return request.deadline_ms if request.deadline_ms is not None else None
+        return max(
+            1e-3, (request.deadline_monotonic - time.monotonic()) * 1000.0
+        )
+
+    def _on_inner(
+        self, request: _RoutedRequest, replica: Replica, response: Dict[str, Any]
+    ) -> None:
+        """Relay a replica's resolution to the client future.  A
+        ``"drain"`` from a replica that is restarting (fleet not
+        draining) is the replica's problem, not the client's — it
+        re-routes instead of surfacing."""
+        with self._lock:
+            self._outstanding[replica.name].pop(request.rid, None)
+        status = response.get("status")
+        if status == STATUS_DRAIN and not self._draining.is_set():
+            self._reroute(request, reason=f"{replica.name} drained")
+            return
+        out = dict(response)
+        out["replica"] = replica.name
+        if request.future.resolve(out) and status == STATUS_OK:
+            self._tel.counter("router.served").inc()
+
+    def _reroute(self, request: _RoutedRequest, reason: str) -> None:
+        """Re-enqueue a request its replica never answered.  Terminal
+        statuses when re-routing is pointless: past its deadline →
+        ``"deadline"``; out of attempts / fleet draining → ``"error"``
+        with the cause.  Counted per cause so the SLO harness can split
+        them."""
+        if request.future.done():
+            return
+        if (
+            request.deadline_monotonic is not None
+            and time.monotonic() > request.deadline_monotonic
+        ):
+            self._tel.counter("router.reroute_deadline").inc()
+            request.future.resolve({"status": STATUS_DEADLINE})
+            return
+        request.attempts += 1
+        if request.attempts > self.config.max_reroutes or self._draining.is_set():
+            self._tel.counter("router.reroute_exhausted").inc()
+            request.future.resolve({
+                "status": STATUS_ERROR,
+                "reason": f"re-route attempts exhausted ({reason})",
+            })
+            return
+        self._tel.counter("router.reroutes").inc()
+        self._route(request)
+
+    # -- fleet health (monitor thread) -----------------------------------------
+
+    def _monitor_loop(self) -> None:
+        cfg = self.config
+        while not self._draining.wait(cfg.monitor_interval_s):
+            for replica in self.replicas:
+                state = replica.check_health(
+                    cfg.heartbeat_timeout_s, cfg.max_batch_errors
+                )
+                if state == REPLICA_SWAPPING:
+                    continue  # the rolling swap owns it
+                if state == REPLICA_DEAD:
+                    self._recover(replica, dead=True)
+                elif state == REPLICA_UNHEALTHY and cfg.auto_restart:
+                    self._recover(replica, dead=False)
+
+    def _recover(self, replica: Replica, dead: bool) -> None:
+        """Evict + re-enqueue + (optionally) restart one failed replica.
+        Runs on a dedicated thread per incident so one slow restart
+        never blinds the monitor to the rest of the fleet."""
+        with self._lock:
+            if self._recovering.get(replica.name):
+                return
+            self._recovering[replica.name] = True
+        if dead:
+            self._tel.counter("router.replica_deaths").inc()
+            self._tel.event("replica_dead", replica=replica.name)
+        thread = threading.Thread(
+            target=_recover_replica,
+            args=(self, replica, dead),
+            name=f"memvul-router-recover-{replica.name}",
+            daemon=True,
+        )
+        thread.start()
+
+    def _reclaim(self, replica: Replica, reason: str) -> None:
+        """Take every routed request still charged to ``replica`` and
+        re-enqueue the unresolved ones (resolved ones were popped by
+        their callbacks; ``ScoreFuture``'s first-resolution-wins makes
+        the race benign)."""
+        with self._lock:
+            taken = self._outstanding[replica.name]
+            self._outstanding[replica.name] = {}
+        for request in taken.values():
+            if not request.future.done():
+                self._reroute(request, reason=reason)
+
+    # -- shutdown --------------------------------------------------------------
+
+    def request_drain(self) -> None:
+        """Begin fleet drain (async-signal-safe: sets a flag)."""
+        self._draining.set()
+
+    def drain(self, timeout: Optional[float] = 30.0) -> None:
+        """Graceful fleet shutdown: stop the monitor, drain every
+        replica (their queued requests resolve ``"drain"`` and — with
+        the fleet draining — surface to clients), close their
+        registries, resolve any stragglers.  Idempotent."""
+        self.request_drain()
+        self._monitor.join(timeout)
+        for replica in self.replicas:
+            replica.close(timeout=timeout or 30.0)
+        with self._lock:
+            leftovers = [
+                request
+                for per_replica in self._outstanding.values()
+                for request in per_replica.values()
+            ]
+            for per_replica in self._outstanding.values():
+                per_replica.clear()
+        for request in leftovers:
+            request.future.resolve({"status": STATUS_DRAIN})
+        self._tel.event("router_drained")
+
+    close = drain
+
+
+def _recover_replica(router: ReplicaRouter, replica: Replica, dead: bool) -> None:
+    """Control-plane recovery for one failed replica: sweep + re-enqueue
+    the requests it still owed, then (policy permitting) restart it
+    through the shared :class:`RetryPolicy` and re-install the fleet's
+    current bank before readmission.  Deliberately OUTSIDE the router
+    class: a restart re-encodes and AOT-warms (``install_bank``), which
+    routing decisions may never do
+    (tools/lint_no_blocking_in_handler.py) — the router's monitor only
+    spawns this worker."""
+    tel = router._tel
+    cfg = router.config
+    try:
+        if dead:
+            # account the abandoned requests on the replica's own
+            # registry (serve.errors / serve.errors_lost) so the
+            # fleet-wide counter invariant survives the death
+            replica.sweep_unresolved()
+        router._reclaim(
+            replica,
+            reason=f"{replica.name} {'died' if dead else 'went unhealthy'}",
+        )
+        if not cfg.auto_restart or router._draining.is_set():
+            return
+        try:
+            restart = lambda: replica.restart(
+                drain_timeout_s=cfg.restart_drain_timeout_s
+            )
+            if router.retry_policy is not None:
+                router.retry_policy.call(
+                    restart, description=f"restart {replica.name}"
+                )
+            else:
+                restart()
+        except Exception as e:  # noqa: BLE001 - a replica restart may fail
+            # for any predictor/device reason; the fleet must keep serving
+            replica.kill(reason=f"restart failed: {e}")
+            replica.sweep_unresolved()
+            tel.counter("router.restart_failures").inc()
+            tel.event(
+                "replica_restart_failed",
+                replica=replica.name,
+                reason=str(e)[:200],
+            )
+            logger.error("%s restart failed: %s", replica.name, e)
+            return
+        # the rebuilt service carries the factory-built bank; sync it to
+        # the fleet's current rollout BEFORE readmission, under the swap
+        # lock so this install serializes with a concurrent rolling swap
+        # — a death mid-rollout cannot resurrect the old bank
+        with router._swap_lock:
+            if (
+                router._bank_instances is not None
+                and replica.bank_version != router._active_version
+            ):
+                replica.accepting.clear()
+                replica.install_bank(
+                    router._bank_instances, version=router._active_version
+                )
+                replica.accepting.set()
+        tel.counter("router.replica_restarts").inc()
+        tel.event(
+            "replica_restart", replica=replica.name, n=replica.restart_count
+        )
+    finally:
+        with router._lock:
+            router._recovering[replica.name] = False
+
+
+def rolling_swap(
+    router: ReplicaRouter,
+    anchor_instances: Iterable[Dict],
+    drain_timeout_s: float = 30.0,
+    poll_interval_s: float = 0.01,
+) -> int:
+    """Roll a new anchor bank across the fleet, one replica at a time.
+
+    Per replica: **stop routing** to it (readmission gate), **drain**
+    its private queue (in-flight work finishes on the old snapshot),
+    **install** the new bank at the next fleet version (encode + AOT
+    pre-warm happen inside ``swap_bank``, off every other replica's
+    request path), then **readmit** it.  The fleet's active version —
+    which new admissions pin to — advances only after every live
+    replica serves the new bank, so no client ever observes a torn
+    rollout: responses during the roll are each stamped with exactly
+    one version, and once the fleet version advances, new requests
+    prefer new-bank replicas.
+
+    Control-plane code: this runs in the caller's thread (wrap it in a
+    background thread to keep a CLI responsive) and deliberately lives
+    OUTSIDE the router class — routing decisions may not encode, warm,
+    or sleep (tools/lint_no_blocking_in_handler.py).  Returns the new
+    fleet version.
+    """
+    instances = list(anchor_instances)
+    tel = router._tel
+    with router._swap_lock:
+        target = router._active_version + 1
+        tel.event(
+            "rolling_swap_start", version=target, replicas=len(router.replicas)
+        )
+        with tel.span("router.rolling_swap", version=target):
+            for replica in router.replicas:
+                if replica.state == REPLICA_DEAD:
+                    # the restart path re-installs the fleet bank before
+                    # readmission (_recover_replica), so a dead member
+                    # cannot resurrect the old bank later
+                    continue
+                with replica._state_lock:
+                    previous_state = replica.state
+                    replica.state = REPLICA_SWAPPING
+                replica.accepting.clear()
+                tel.event("replica_swap_begin", replica=replica.name)
+                deadline = time.monotonic() + drain_timeout_s
+                while (
+                    replica.service.queue_depth > 0
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(poll_interval_s)
+                replica.install_bank(instances, version=target)
+                with replica._state_lock:
+                    replica.state = previous_state
+                replica.accepting.set()
+                tel.event(
+                    "replica_swap_done", replica=replica.name, version=target
+                )
+        router._bank_instances = instances
+        router._active_version = target
+    tel.counter("router.bank_swaps").inc()
+    tel.gauge("router.bank_version").set(target)
+    tel.event("rolling_swap_done", version=target)
+    logger.info(
+        "rolling swap complete: fleet at bank v%d (%d replicas)",
+        target, len(router.replicas),
+    )
+    return target

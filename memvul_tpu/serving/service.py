@@ -89,15 +89,29 @@ class ScoreFuture:
     event, never on the batcher's locks (the HTTP handler contract the
     ``lint_no_blocking_in_handler`` tool enforces: enqueue + wait only)."""
 
-    __slots__ = ("_event", "_response", "_lock")
+    __slots__ = ("_event", "_response", "_lock", "_callbacks")
 
     def __init__(self) -> None:
         self._event = threading.Event()
         self._response: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
+        self._callbacks: List[Any] = []
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(response)`` when the future resolves (immediately if
+        it already has).  The router's relay path: it registers one
+        callback per routed request instead of parking a waiter thread
+        per replica.  Callbacks run on the resolving thread (the
+        replica's batcher) and must be cheap and non-raising."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+            response = self._response
+        fn(response)
 
     def resolve(self, response: Dict[str, Any]) -> bool:
         """First resolution wins; later ones are ignored (a request has
@@ -106,8 +120,14 @@ class ScoreFuture:
             if self._event.is_set():
                 return False
             self._response = response
+            callbacks, self._callbacks = self._callbacks, []
             self._event.set()
-            return True
+        for fn in callbacks:  # outside the lock: a callback may re-submit
+            try:
+                fn(response)
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("score-future callback failed")
+        return True
 
     def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
         if not self._event.wait(timeout):
@@ -153,6 +173,7 @@ class ScoringService:
         config: Optional[ServiceConfig] = None,
         retry_policy: Optional[RetryPolicy] = None,
         manifest_dir: Optional[Union[str, Path]] = None,
+        registry=None,
     ) -> None:
         if getattr(predictor, "anchor_bank", None) is None:
             raise RuntimeError(
@@ -184,8 +205,17 @@ class ScoringService:
         # the queue condition — same non-reentrancy hazard the trainer's
         # preemption handler avoids by only setting a flag
         self._draining = threading.Event()
+        # hard-kill flag: the in-process analogue of SIGKILLing a
+        # replica worker — the batcher abandons its work UNRESOLVED (no
+        # drain statuses, no counters) so a supervisor must sweep
+        # survivors via :meth:`take_unresolved` (serving/replica.py)
+        self._killed = threading.Event()
+        self._inflight: List[_Request] = []  # guarded by self._cond
         self._closed = threading.Event()
-        self._tel = get_registry()
+        # the replica tier gives each service its own registry so one
+        # process can host N replicas with separable health/counters;
+        # the single-service path keeps the process-wide default
+        self._tel = registry if registry is not None else get_registry()
         self._write_manifest()
         self._thread = threading.Thread(
             target=self._loop, name="memvul-serve-batcher", daemon=True
@@ -242,6 +272,37 @@ class ScoringService:
         with self._bank_lock:
             return self._bank.labels
 
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def batcher_alive(self) -> bool:
+        """Whether the batcher thread is running (a replica health
+        signal: a batcher that exited without a drain is a dead
+        replica)."""
+        return self._thread.is_alive()
+
+    @property
+    def default_deadline_ms(self) -> float:
+        """The per-request budget handlers size their waits from — one
+        attribute shared with :class:`~memvul_tpu.serving.router
+        .ReplicaRouter` so the front end serves either."""
+        return self.config.default_deadline_ms
+
+    def health_summary(self) -> Dict[str, Any]:
+        """The ``/healthz`` JSON body: drain state plus queue depth and
+        the active bank version, so an external probe can tell
+        "draining" from "healthy but backed up".  The router's override
+        adds the per-replica fleet view (docs/serving.md)."""
+        draining = self._draining.is_set()
+        return {
+            "status": "draining" if draining else "ok",
+            "draining": draining,
+            "queue_depth": self.queue_depth,
+            "bank_version": self.bank_version,
+        }
+
     # -- shutdown --------------------------------------------------------------
 
     def request_drain(self) -> None:
@@ -261,6 +322,35 @@ class ScoringService:
         self._closed.set()
 
     close = drain
+
+    def hard_kill(self) -> None:
+        """Die like a SIGKILLed worker: stop pulling immediately, resolve
+        NOTHING (no drain statuses, no served/shed counters for work in
+        flight), leave the queue as-is.  The chaos path behind the
+        ``replica.kill`` fault point — a supervisor must follow up with
+        :meth:`take_unresolved` to account the casualties and re-enqueue
+        them elsewhere (serving/replica.py, docs/serving.md)."""
+        self._killed.set()
+        self._draining.set()  # wakes the pull loop; _loop checks killed
+
+    @property
+    def killed(self) -> bool:
+        return self._killed.is_set()
+
+    def take_unresolved(self, timeout: float = 5.0) -> List[_Request]:
+        """After :meth:`hard_kill`: every accepted-but-unresolved request
+        (queued + the abandoned in-flight pull).  Waits briefly for the
+        batcher to notice the kill; a batcher wedged inside a device op
+        cannot be interrupted (threads, like SIGKILLed pods, don't get a
+        say) — its requests are still returned, and the killed flag
+        keeps it from resolving them later."""
+        self._thread.join(timeout)
+        with self._cond:
+            pending = [r for r in self._inflight if not r.future.done()]
+            pending += [r for r in self._queue if not r.future.done()]
+            self._queue.clear()
+            self._inflight = []
+        return pending
 
     def install_signal_handlers(self) -> List[Tuple[int, Any]]:
         """SIGTERM (the managed-pod preemption notice) and SIGINT begin
@@ -287,7 +377,9 @@ class ScoringService:
 
     # -- hot anchor-bank swap --------------------------------------------------
 
-    def swap_bank(self, anchor_instances: Iterable[Dict]) -> int:
+    def swap_bank(
+        self, anchor_instances: Iterable[Dict], version: Optional[int] = None
+    ) -> int:
         """Re-encode a new anchor set and atomically install it.
 
         Runs in the *caller's* thread (callers wrap it in a background
@@ -296,7 +388,13 @@ class ScoringService:
         entirely before the swap, so the batcher never sees a shape it
         has not compiled.  In-flight micro-batches keep the snapshot
         they captured; the next batch picks up the new version.  Returns
-        the new version number."""
+        the new version number.
+
+        ``version`` pins the installed snapshot's number instead of the
+        default ``current + 1`` — the replica tier uses it so every
+        member of a fleet stamps one rollout with ONE number (a
+        restarted replica re-installs the fleet's bank at the fleet's
+        version; its own counter restarted at 1)."""
         with self._swap_lock:
             bank, labels, n_anchors = self.predictor.encode_bank(
                 anchor_instances
@@ -317,7 +415,8 @@ class ScoringService:
                     self.predictor.warmup_bank_shapes(bank)
             with self._bank_lock:
                 new = _BankVersion(
-                    version=current.version + 1,
+                    version=current.version + 1 if version is None
+                    else int(version),
                     array=bank,
                     labels=tuple(labels),
                     n_anchors=n_anchors,
@@ -367,12 +466,25 @@ class ScoringService:
     def _loop(self) -> None:
         while not self._draining.is_set():
             pulled = self._pull_batch()
-            if pulled:
-                # a pull that completed before the drain flag was seen is
-                # the in-flight work — it finishes (the trainer's
-                # finish-the-step contract); everything still queued sheds
-                self._dispatch(pulled)
-                self._tel.heartbeat()
+            if not pulled:
+                continue
+            # the pull is the in-flight work; track it so a hard kill's
+            # sweep can find requests that were popped but never resolved
+            with self._cond:
+                self._inflight = list(pulled)
+            if self._killed.is_set():
+                return  # killed mid-pull: abandon (sweep will account)
+            # a pull that completed before the drain flag was seen is
+            # the in-flight work — it finishes (the trainer's
+            # finish-the-step contract); everything still queued sheds
+            self._dispatch(pulled)
+            if self._killed.is_set():
+                return  # keep _inflight visible for take_unresolved
+            with self._cond:
+                self._inflight = []
+            self._tel.heartbeat()
+        if self._killed.is_set():
+            return  # a killed worker resolves nothing
         self._shed_queue(STATUS_DRAIN)
         self._tel.event("serve_drained")
         self._tel.heartbeat(force=True)
@@ -385,12 +497,20 @@ class ScoringService:
         is noticed promptly."""
         cfg = self.config
         pulled: List[_Request] = []
-        with self._cond:
-            while not self._queue:
+        while True:
+            with self._cond:
+                if self._queue:
+                    pulled.append(self._queue.popleft())
+                    break
                 if self._draining.is_set():
                     return pulled
                 self._cond.wait(0.05)
-            pulled.append(self._queue.popleft())
+            # idle liveness tick, OUTSIDE the queue lock (heartbeat may
+            # write HEARTBEAT.json, rate-limited): an idle-but-polling
+            # batcher keeps its heartbeat age near zero, so the router's
+            # missed-heartbeat eviction fires only on a genuinely wedged
+            # replica, never an unloaded one
+            self._tel.heartbeat()
         flush_at = time.monotonic() + cfg.max_wait_ms / 1000.0
         while len(pulled) < cfg.max_batch and not self._draining.is_set():
             remaining = flush_at - time.monotonic()
@@ -433,6 +553,8 @@ class ScoringService:
             rows = self._rows_by_length[length]
             group = groups[length]
             for start in range(0, len(group), rows):
+                if self._killed.is_set():
+                    return  # abandoned — the kill sweep takes over
                 self._score_chunk(group[start : start + rows], length, rows, bank)
 
     def _bucket_for(self, n_tokens: int) -> int:
@@ -479,6 +601,8 @@ class ScoringService:
                 dev = self.retry_policy.call(once, description="serve batch")
             probs = np.asarray(dev)[: len(chunk), : bank.n_anchors]
         except Exception as e:
+            if self._killed.is_set():
+                return  # a killed worker neither counts nor resolves
             reason = exception_text(e)
             logger.error(
                 "serve batch dead-lettered (%d request(s)): %s",
@@ -490,6 +614,8 @@ class ScoringService:
             for request, _ in chunk:
                 request.future.resolve(dict(response))
             return
+        if self._killed.is_set():
+            return  # killed mid-dispatch: the sweep accounts this chunk
         tel.histogram("serve.batch_latency_s").observe(
             time.perf_counter() - start
         )

@@ -67,6 +67,71 @@ def _fmt_num(v: Any) -> str:
     return str(v)
 
 
+_ROUTER_EVENT_KINDS = frozenset({
+    "router_start", "router_drained", "replica_dead", "replica_restart",
+    "replica_restart_failed", "rolling_swap_start", "rolling_swap_done",
+    "replica_swap_begin", "replica_swap_done",
+})
+
+
+def _replica_section(
+    run_dir: Path, events: List[Dict[str, Any]], now: float
+) -> List[str]:
+    """Per-replica rows for a scale-out serving run dir (serve
+    ``--replicas``): each ``replica-<i>/`` subdir carries that
+    replica's own PR 3 sinks, and the main event stream carries the
+    router's lifecycle events.  Rendered whenever either is present; a
+    replica that never wrote events (killed before its first flush, or
+    telemetry disabled) renders as an explicit "(no telemetry
+    recorded)" row instead of vanishing — its absence is exactly the
+    post-mortem signal."""
+    replica_dirs = sorted(
+        d for d in run_dir.glob("replica-*") if d.is_dir()
+    )
+    router_events = [
+        ev for ev in events if ev.get("kind") in _ROUTER_EVENT_KINDS
+    ]
+    if not (replica_dirs or router_events):
+        return []
+    restarts: Dict[str, int] = {}
+    deaths: Dict[str, int] = {}
+    for ev in router_events:
+        name = str(ev.get("replica", "?"))
+        if ev.get("kind") == "replica_restart":
+            restarts[name] = restarts.get(name, 0) + 1
+        elif ev.get("kind") == "replica_dead":
+            deaths[name] = deaths.get(name, 0) + 1
+    lines = ["REPLICAS"]
+    if router_events:
+        lines.append(
+            f"  router events: {len(router_events)}"
+            + (f"  deaths: {sum(deaths.values())}" if deaths else "")
+            + (f"  restarts: {sum(restarts.values())}" if restarts else "")
+        )
+    for replica_dir in replica_dirs:
+        name = replica_dir.name
+        sub = load_run(replica_dir)
+        counters = dict((sub["summary"] or {}).get("counters") or {})
+        if not counters:
+            counters = dict((sub["heartbeat"] or {}).get("counters") or {})
+        if not (sub["events"] or sub["summary"] or sub["heartbeat"]):
+            lines.append(f"  {name}: (no telemetry recorded)")
+            continue
+        heartbeat = sub["heartbeat"] or {}
+        try:
+            age: Optional[float] = now - float(heartbeat.get("written_wall"))
+        except (TypeError, ValueError):
+            age = None
+        lines.append(
+            f"  {name}: heartbeat {_fmt_s(age)} ago"
+            f"  served={_fmt_num(counters.get('serve.served', 0))}"
+            f"  shed={_fmt_num(counters.get('serve.shed', 0))}"
+            f"  errors={_fmt_num(counters.get('serve.errors', 0))}"
+            f"  restarts={_fmt_num(counters.get('replica.restarts', restarts.get(name, 0)))}"
+        )
+    return lines
+
+
 def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str:
     """The human summary as one string (the CLI prints it verbatim)."""
     data = load_run(run_dir)
@@ -98,6 +163,12 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
             )
         else:
             lines.append("  (no telemetry sinks found in this directory)")
+        # a fleet run dir may carry per-replica sinks even when the
+        # router process itself recorded nothing — still render them
+        replica_lines = _replica_section(data["run_dir"], events, now)
+        if replica_lines:
+            lines.append("")
+            lines.extend(replica_lines)
         return "\n".join(lines)
     if not events:
         # heartbeat-/summary-only dirs (a SIGKILL before the first event
@@ -193,6 +264,12 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
         lines.append("GAUGES")
         for name in sorted(gauges):
             lines.append(f"  {name} = {_fmt_num(gauges[name])}")
+
+    # -- replicas (scale-out serving runs) ------------------------------------
+    replica_lines = _replica_section(data["run_dir"], events, now)
+    if replica_lines:
+        lines.append("")
+        lines.extend(replica_lines)
 
     # -- last events ----------------------------------------------------------
     if events:

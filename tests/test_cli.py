@@ -27,6 +27,23 @@ def ws(tmp_path_factory):
     return build_workspace(tmp_path_factory.mktemp("cli"), seed=11)
 
 
+@pytest.fixture(scope="module")
+def trained_ser_dir(ws, tmp_path_factory):
+    """One CLI-trained tiny archive shared by every evaluate-flag test
+    below (each used to re-train an identical model — ~40% of this
+    file's tier-1 wall clock).  Evaluation is read-only on the archive;
+    each test still writes to its own output dir.  The train path
+    itself stays covered by test_cli_train_then_evaluate_memory, which
+    asserts on the training artifacts."""
+    base = tmp_path_factory.mktemp("cli_shared_train")
+    config = tiny_memory_config(ws)
+    cfg_path = base / "config.json"
+    cfg_path.write_text(json.dumps(config))
+    ser_dir = base / "out"
+    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
+    return ser_dir
+
+
 def tiny_memory_config(ws, **trainer_kw):
     # the shared selfcheck geometry (memvul_tpu/data/synthetic.py) —
     # the CLI `selfcheck` command trains exactly this
@@ -304,16 +321,11 @@ def test_cli_mesh_flag_end_to_end(ws, tmp_path):
         assert exc.value.code == 2, bad
 
 
-def test_cli_evaluate_threshold_flag_reaches_metrics(ws, tmp_path):
+def test_cli_evaluate_threshold_flag_reaches_metrics(ws, trained_ser_dir, tmp_path):
     """--threshold carries the validation-chosen decision threshold into
     cal_metrics (reference: predict_memory.py thres argument); the
     metric file must record it and the confusion counts must respond."""
-    config = tiny_memory_config(ws)
-    cfg_path = tmp_path / "config.json"
-    cfg_path.write_text(json.dumps(config))
-    ser_dir = tmp_path / "out"
-    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
-
+    ser_dir = trained_ser_dir
     overrides = json.dumps({"evaluation": {"batch_size": 8, "max_length": 48}})
     for thres in ("0.1", "0.9"):
         out = tmp_path / f"ev_{thres}"
@@ -334,16 +346,11 @@ def test_cli_evaluate_threshold_flag_reaches_metrics(ws, tmp_path):
         assert m["TP"] + m["FP"] == expected_pos, thres
 
 
-def test_cli_evaluate_jsonl_stream_matches_json(ws, tmp_path):
+def test_cli_evaluate_jsonl_stream_matches_json(ws, trained_ser_dir, tmp_path):
     """The docs/full_corpus.md recipe: evaluating a ``.jsonl`` stream
     (the 1.2M-report format) through the CLI must produce the same
     metrics as the equivalent ``.json`` corpus."""
-    config = tiny_memory_config(ws)
-    cfg_path = tmp_path / "config.json"
-    cfg_path.write_text(json.dumps(config))
-    ser_dir = tmp_path / "out"
-    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
-
+    ser_dir = trained_ser_dir
     samples = json.loads(Path(ws["paths"]["test"]).read_text())
     stream = tmp_path / "test_stream.jsonl"
     stream.write_text("\n".join(json.dumps(s) for s in samples))
@@ -367,17 +374,12 @@ def test_cli_evaluate_jsonl_stream_matches_json(ws, tmp_path):
         assert m_jsonl[key] == pytest.approx(m_json[key], abs=1e-6), key
 
 
-def test_cli_evaluate_golden_file_swaps_anchor_bank(ws, tmp_path):
+def test_cli_evaluate_golden_file_swaps_anchor_bank(ws, trained_ser_dir, tmp_path):
     """--golden-file replaces the archive config's anchor bank at eval
     time (reference: predict_memory.py's golden file argument) — the
     entry point of the CWE-1000 full-view flow.  Result records must
     score against the ALTERNATE bank's labels."""
-    config = tiny_memory_config(ws)
-    cfg_path = tmp_path / "config.json"
-    cfg_path.write_text(json.dumps(config))
-    ser_dir = tmp_path / "out"
-    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
-
+    ser_dir = trained_ser_dir
     anchors = json.loads(Path(ws["paths"]["anchors"]).read_text())
     extra_label = "CWE-TEST-ONLY"
     anchors[extra_label] = "A synthetic anchor describing a test weakness."
@@ -439,18 +441,13 @@ def test_cli_profile_flags_write_traces(ws, tmp_path):
     assert mlm_trace.exists() and any(mlm_trace.rglob("*"))
 
 
-def test_eval_config_inflight_reaches_dispatch(ws, tmp_path, monkeypatch):
+def test_eval_config_inflight_reaches_dispatch(ws, trained_ser_dir, tmp_path, monkeypatch):
     """``evaluation.inflight`` (async device dispatch depth) must reach
     score_instances — it is a first-class sweep knob on chip."""
     from memvul_tpu.build import evaluate_from_archive
     from memvul_tpu.evaluate import predict_memory as pm
 
-    config = tiny_memory_config(ws)
-    cfg_path = tmp_path / "config.json"
-    cfg_path.write_text(json.dumps(config))
-    ser_dir = tmp_path / "out"
-    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
-
+    ser_dir = trained_ser_dir
     seen = {}
     real = pm.SiamesePredictor.score_instances
 
@@ -657,16 +654,11 @@ def test_online_resample_off_freezes_pairs(ws, tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
-def test_cli_evaluate_with_int8_quant_override(ws, tmp_path):
+def test_cli_evaluate_with_int8_quant_override(ws, trained_ser_dir, tmp_path):
     """The shipped int8 eval config drives the quantized scoring path on
     an archived full-precision model: same checkpoint, metric files come
     out, quant flag actually reaches the rebuilt model."""
-    config = tiny_memory_config(ws)
-    cfg_path = tmp_path / "config.json"
-    cfg_path.write_text(json.dumps(config))
-    ser_dir = tmp_path / "out"
-    assert main(["train", str(cfg_path), "-s", str(ser_dir)]) == 0
-
+    ser_dir = trained_ser_dir
     shipped = loads_config(
         (CONFIGS_DIR / "test_config_memory_int8.json").read_text()
     )
@@ -723,3 +715,12 @@ def test_cli_help_names_every_registered_subcommand(capsys):
     out = capsys.readouterr().out
     for name in names:
         assert name in out, f"--help does not mention {name!r}"
+    # the serve subcommand's flag surface is pinned too: the scale-out
+    # tier's --replicas (docs/serving.md "Replica tier") must stay
+    # registered alongside the PR 4 flags
+    serve_flags = {
+        flag
+        for action in sub.choices["serve"]._actions
+        for flag in action.option_strings
+    }
+    assert {"--replicas", "--out-dir", "--overrides", "--port"} <= serve_flags

@@ -1,7 +1,9 @@
 """Tier-1 invariant: HTTP handler classes only enqueue + wait on a
-future (tools/lint_no_blocking_in_handler.py) — a handler that sleeps
-or scores inline serializes the server behind one connection and can
-trigger mid-serve compiles (docs/serving.md)."""
+future, and router dispatch classes only select a replica queue
+(tools/lint_no_blocking_in_handler.py) — a handler that sleeps or
+scores inline serializes the server behind one connection and can
+trigger mid-serve compiles; a router that does it stalls every request
+in the process (docs/serving.md)."""
 
 import sys
 from pathlib import Path
@@ -46,6 +48,35 @@ def test_lint_flags_planted_offenders(tmp_path):
     assert any(o.endswith("sleep") for o in offenders)
     assert any(o.endswith("predict_file") for o in offenders)
     assert any(o.endswith("swap_bank") for o in offenders)
+
+
+def test_lint_flags_router_dispatch_offenders(tmp_path):
+    """Routing decisions may not score, install banks, or sleep — only
+    select a replica queue; subclasses of a *Router inherit the ban."""
+    (tmp_path / "bad_router.py").write_text(
+        "import time\n"
+        "class MyRouter:\n"
+        "    def _pick(self, request):\n"
+        "        time.sleep(0.1)\n"
+        "        return self.replicas[0].service.predict_one(request)\n"
+        "class Weighted(MyRouter):\n"
+        "    def _pick(self, request):\n"
+        "        self.replicas[0].install_bank([])\n"
+        "        return None\n"
+    )
+    (tmp_path / "ok_router.py").write_text(
+        "class CleanRouter:\n"
+        "    def _pick(self, request):\n"
+        "        return min(self.replicas, key=lambda r: r.queue_depth)\n"
+        "def control_plane(replica):\n"
+        "    replica.install_bank([])  # outside the class: allowed\n"
+    )
+    offenders = find_blocking_calls(tmp_path)
+    assert len(offenders) == 3
+    assert all("bad_router.py" in o for o in offenders)
+    assert any(o.endswith("sleep") for o in offenders)
+    assert any(o.endswith("predict_one") for o in offenders)
+    assert any(o.endswith("install_bank") for o in offenders)
 
 
 def test_lint_cli_exit_codes(tmp_path, capsys):

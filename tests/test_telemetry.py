@@ -457,3 +457,51 @@ def test_report_cli_exit_codes_on_degenerate_dirs(tmp_path, capsys):
     assert main(["telemetry-report", str(empty)]) == 0
     assert "no events recorded" in capsys.readouterr().out
     assert main(["telemetry-report", str(tmp_path / "missing")]) == 2
+
+
+def test_report_renders_per_replica_sections(tmp_path):
+    """A scale-out serve run dir (router events + replica-<i>/ subdirs)
+    renders a REPLICAS section: heartbeat age, served/shed/errors and
+    restart count per replica — and a replica that never wrote events
+    (killed before its first flush) renders an explicit "(no telemetry
+    recorded)" row instead of vanishing."""
+    run = tmp_path / "fleet_run"
+    router = TelemetryRegistry(run_dir=run, enabled=True)
+    router.event("router_start", replicas=2)
+    router.event("replica_dead", replica="replica-1")
+    router.event("replica_restart", replica="replica-1", n=1)
+    router.event("rolling_swap_done", version=2)
+    router.close()
+
+    healthy = TelemetryRegistry(run_dir=run / "replica-0", enabled=True)
+    healthy.counter("serve.served").inc(41)
+    healthy.counter("serve.shed").inc(2)
+    healthy.counter("serve.errors").inc(1)
+    healthy.heartbeat(force=True)
+    healthy.close()
+    (run / "replica-1").mkdir()  # died before any sink flushed
+
+    text = render_report(run)
+    assert "REPLICAS" in text
+    assert "deaths: 1" in text and "restarts: 1" in text
+    assert "replica-0" in text
+    assert "served=41" in text and "shed=2" in text and "errors=1" in text
+    assert "replica-1: (no telemetry recorded)" in text
+
+
+def test_report_replica_dirs_without_router_events(tmp_path):
+    """Per-replica sinks render even when the router process itself
+    recorded nothing (telemetry sinks disabled at the top level)."""
+    run = tmp_path / "quiet_fleet"
+    run.mkdir()
+    member = TelemetryRegistry(run_dir=run / "replica-0", enabled=True)
+    member.counter("serve.served").inc(7)
+    member.counter("replica.restarts").inc(3)
+    member.heartbeat(force=True)
+    member.close()
+
+    text = render_report(run)
+    assert "no telemetry sinks" in text  # the top-level dir really is bare
+    assert "REPLICAS" in text
+    assert "served=7" in text
+    assert "restarts=3" in text

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Lint: HTTP handler threads may only enqueue + wait on a future.
+"""Lint: HTTP handler threads may only enqueue + wait on a future, and
+router dispatch classes may only select a replica queue.
 
 The serving front end (memvul_tpu/serving/frontend.py) runs one thread
 per connection.  A handler that calls ``time.sleep`` or any scoring/
@@ -8,17 +9,30 @@ connection and — worse — can trigger the mid-serve XLA compiles the
 micro-batcher exists to prevent (docs/serving.md).  The allowed surface
 is exactly: ``service.submit(...)`` and ``future.result(...)``.
 
-The check is AST-based: every class whose base name ends with
-``RequestHandler`` (stdlib ``BaseHTTPRequestHandler`` or a subclass) is
-scanned for calls to a blocking/scoring name, wherever the class lives
-under the package dir.  Flagged names:
+The replica router (memvul_tpu/serving/router.py) lives under the same
+discipline one layer down: a *routing decision* reads queue depths and
+picks a replica — it may never encode, score, warm, swap, or sleep
+inline, because every request in the process is behind it.  Heavy fleet
+operations (restart rebuilds, bank installs) belong to Replica methods
+invoked from control-plane code (the monitor's worker threads, the
+module-level ``rolling_swap``), not to the router class body.
+
+The check is AST-based, over two class families wherever they live
+under the package dir:
+
+* classes whose *base* name ends with ``RequestHandler`` (stdlib
+  ``BaseHTTPRequestHandler`` or a subclass) — handler threads;
+* classes whose own or base name ends with ``Router`` — dispatch
+  classes.
+
+Flagged names in either family:
 
 * ``sleep`` (``time.sleep`` or a bare imported ``sleep``);
 * anything starting with ``predict`` (``predict_file``, ``predict_one``);
 * the scoring/encoding entry points: ``score_instances``,
   ``encode_anchors``, ``encode_bank``, ``warmup_compile``,
-  ``warmup_bank_shapes``, ``swap_bank``, and the raw jitted program
-  ``_score_fn``.
+  ``warmup_bank_shapes``, ``swap_bank``, ``install_bank``, and the raw
+  jitted program ``_score_fn``.
 
 Usage: ``python tools/lint_no_blocking_in_handler.py [package_dir]`` —
 exits 1 listing offenders, 0 when clean, 2 on a bad argument.  Invoked
@@ -40,6 +54,7 @@ FORBIDDEN_NAMES = {
     "warmup_compile",
     "warmup_bank_shapes",
     "swap_bank",
+    "install_bank",
     "_score_fn",
 }
 FORBIDDEN_PREFIXES = ("predict",)
@@ -66,9 +81,25 @@ def _is_handler_class(node: ast.ClassDef) -> bool:
     return False
 
 
+def _is_router_class(node: ast.ClassDef) -> bool:
+    """A router dispatch class: named ``*Router`` or deriving from one
+    (the serving tier's ``ReplicaRouter`` and anything that subclasses
+    it to customize the routing policy)."""
+    if node.name.endswith("Router"):
+        return True
+    for base in node.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        if name.endswith("Router"):
+            return True
+    return False
+
+
 def find_blocking_calls(package_dir: Path) -> List[str]:
     """``path:line: name`` for every forbidden call inside a
-    ``*RequestHandler`` subclass under ``package_dir``."""
+    ``*RequestHandler`` subclass or a ``*Router`` dispatch class under
+    ``package_dir``."""
     offenders: List[str] = []
     for path in sorted(package_dir.rglob("*.py")):
         try:
@@ -77,7 +108,10 @@ def find_blocking_calls(package_dir: Path) -> List[str]:
             offenders.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
             continue
         for node in ast.walk(tree):
-            if not (isinstance(node, ast.ClassDef) and _is_handler_class(node)):
+            if not (
+                isinstance(node, ast.ClassDef)
+                and (_is_handler_class(node) or _is_router_class(node))
+            ):
                 continue
             for call in ast.walk(node):
                 if not isinstance(call, ast.Call):
@@ -100,12 +134,12 @@ def main(argv: List[str] | None = None) -> int:
         return 2
     offenders = find_blocking_calls(package_dir)
     for line in offenders:
-        print(f"blocking call in HTTP handler: {line}")
+        print(f"blocking call in handler/router class: {line}")
     if offenders:
         print(
-            f"{len(offenders)} blocking call(s) in handler classes — a "
-            "handler may only submit() and wait on the future "
-            "(docs/serving.md)"
+            f"{len(offenders)} blocking call(s) in handler/router classes "
+            "— a handler may only submit() and wait on the future; a "
+            "router may only select a replica queue (docs/serving.md)"
         )
         return 1
     return 0
