@@ -12,6 +12,7 @@ scripts (reference: README.md:130-147).  Here everything is one CLI:
     python -m memvul_tpu build-data --csv all_samples.csv --out data/
     python -m memvul_tpu analyze data/train_project.json
     python -m memvul_tpu bench
+    python -m memvul_tpu bank build --store banks/ --anchors data/CWE_anchor_golden_project.json
     python -m memvul_tpu telemetry-report out/
     python -m memvul_tpu doctor
     python -m memvul_tpu parity --hf-dir bert-base-uncased
@@ -342,6 +343,9 @@ def cmd_serve(args) -> int:
             stop.wait(0.5)
     finally:
         server.shutdown()
+        monitor = getattr(service, "drift_monitor", None)
+        if monitor is not None:
+            monitor.stop()
         service.drain()
         for sig, handler in previous:
             _signal.signal(sig, handler)
@@ -353,6 +357,169 @@ def cmd_bench(args) -> int:
     from .bench import main as bench_main
 
     return int(bench_main() or 0)
+
+
+# -- anchor-bank lifecycle (bankops/, docs/anchor_bank.md) ---------------------
+
+def _bank_predictor(args):
+    """A warmed serving-shaped predictor over an archive — what the
+    shadow/promote subcommands score candidate banks through."""
+    from .archive import load_archive
+    from .build import build_reader
+    from .config import serving_config
+    from .evaluate.predict_memory import SiamesePredictor
+
+    arch = load_archive(args.archive, overrides=args.overrides)
+    serve_cfg = serving_config(arch.config)
+    max_length = int(serve_cfg["max_length"])
+    model_positions = getattr(
+        getattr(arch.model, "config", None), "max_position_embeddings", None
+    )
+    if model_positions is not None and max_length > model_positions:
+        max_length = model_positions
+    buckets = serve_cfg["buckets"]
+    predictor = SiamesePredictor(
+        arch.model,
+        arch.params,
+        arch.tokenizer,
+        batch_size=int(serve_cfg["max_batch"]),
+        max_length=max_length,
+        buckets=[int(b) for b in buckets] if buckets else None,
+        aot_warmup=False,  # warmed per bank by score_texts callers
+    )
+    reader = build_reader(arch.config.get("dataset_reader"))
+    return predictor, reader
+
+
+def cmd_bank_build(args) -> int:
+    """Commit an anchor set (the ``build-data`` output JSON) as a root
+    store version."""
+    from .bankops import BankStore
+    from .data.cwe import load_anchors
+
+    store = BankStore(args.store)
+    manifest = store.create(
+        load_anchors(args.anchors), source=args.source, note=args.note
+    )
+    print(json.dumps(manifest, indent=2))
+    return 0
+
+
+def cmd_bank_diff(args) -> int:
+    """Derive a new version from a parent via add/retire/reweight/edit
+    ops (``--ops`` JSON plus the repeatable conveniences)."""
+    from .bankops import BankDiff, BankStore, BankStoreError
+
+    store = BankStore(args.store)
+    ops = []
+    if args.ops:
+        raw = args.ops
+        if Path(raw).exists():
+            raw = Path(raw).read_text()
+        ops.extend(json.loads(raw))
+    for cat in args.retire or []:
+        ops.append({"op": "retire", "category": cat})
+    for spec in args.reweight or []:
+        cat, _, weight = spec.partition("=")
+        ops.append({"op": "reweight", "category": cat, "weight": float(weight)})
+    parent = args.parent or store.latest()
+    if parent is None:
+        print("bank diff: empty store — run `bank build` first", file=sys.stderr)
+        return 2
+    try:
+        manifest = store.derive(
+            parent, BankDiff.from_json(ops), note=args.note
+        )
+    except BankStoreError as e:
+        print(f"bank diff: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(manifest, indent=2))
+    return 0
+
+
+def cmd_bank_log(args) -> int:
+    """Lineage of a version (default: latest), root first, plus the
+    ACTIVE pointer."""
+    from .bankops import BankStore
+
+    store = BankStore(args.store)
+    print(json.dumps({
+        "versions": store.versions(),
+        "active": store.active(),
+        "lineage": store.log(args.version),
+    }, indent=2))
+    return 0
+
+
+def cmd_bank_shadow(args) -> int:
+    """Offline shadow: replay a journaled ``predict_file`` output
+    against a candidate store version; writes ``shadow_deltas.jsonl``
+    and prints the gate-consumable summary."""
+    from .bankops import BankStore, replay_results
+
+    store = BankStore(args.store)
+    predictor, reader = _bank_predictor(args)
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    summary = replay_results(
+        predictor,
+        store.instances(args.candidate),
+        reader,
+        corpus_path=args.corpus,
+        results_path=args.results,
+        out_dir=out_dir,
+        split=args.split,
+        threshold=args.threshold,
+        candidate_version=args.candidate,
+    )
+    from .resilience.io import atomic_write_text
+
+    atomic_write_text(
+        out_dir / "shadow_summary.json", json.dumps(summary, indent=2)
+    )
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def cmd_bank_promote(args) -> int:
+    """Run the promotion gate for a candidate: golden-set AUC/F1 parity
+    vs the active version plus shadow-summary thresholds.  Prints the
+    machine-readable decision; ``--apply`` additionally advances the
+    store's ACTIVE pointer (a live fleet promotes in-process via
+    ``bankops.promote``).  Exit 0 approved, 1 refused, 2 usage."""
+    from .bankops import BankStore, GateThresholds, evaluate_candidate
+    from .bankops.store import BankStoreError
+
+    store = BankStore(args.store)
+    predictor, reader = _bank_predictor(args)
+    shadow_summary = None
+    if args.shadow_summary:
+        shadow_summary = json.loads(Path(args.shadow_summary).read_text())
+    thresholds = GateThresholds(
+        max_auc_drop=args.max_auc_drop,
+        max_f1_drop=args.max_f1_drop,
+        max_flip_rate=args.max_flip_rate,
+        min_shadow_samples=args.min_shadow_samples,
+        require_shadow=not args.no_shadow,
+    )
+    try:
+        decision = evaluate_candidate(
+            predictor,
+            store,
+            args.candidate,
+            reader.read(str(args.golden_set), split=args.split),
+            active=args.active,
+            shadow_summary=shadow_summary,
+            thresholds=thresholds,
+        )
+    except BankStoreError as e:
+        print(f"bank promote: {e}", file=sys.stderr)
+        return 2
+    store.record_promotion(kind="gate_decision", **decision.to_json())
+    if decision.approved and args.apply:
+        store.set_active(args.candidate, source="promotion")
+    print(json.dumps(decision.to_json(), indent=2))
+    return 0 if decision.approved else 1
 
 
 def cmd_telemetry_report(args) -> int:
@@ -551,6 +718,94 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("bench", help="run the throughput benchmark")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "bank",
+        help="anchor-bank lifecycle: versioned store (build/diff/log), "
+        "offline shadow scoring of a candidate version, and the gated "
+        "promotion check (docs/anchor_bank.md)",
+    )
+    bank_sub = p.add_subparsers(dest="bank_command", required=True)
+
+    b = bank_sub.add_parser(
+        "build", help="commit an anchor JSON as a root store version"
+    )
+    b.add_argument("--store", required=True, help="bank store root dir")
+    b.add_argument("--anchors", required=True,
+                   help="anchor JSON (e.g. CWE_anchor_golden_project.json)")
+    b.add_argument("--source", default="build", help="provenance tag")
+    b.add_argument("--note", default=None)
+    b.set_defaults(fn=cmd_bank_build)
+
+    b = bank_sub.add_parser(
+        "diff", help="derive a new version via add/retire/reweight/edit ops"
+    )
+    b.add_argument("--store", required=True)
+    b.add_argument("--parent", default=None,
+                   help="parent version id (default: latest)")
+    b.add_argument("--ops", default=None,
+                   help="JSON list of diff ops (inline or a file path)")
+    b.add_argument("--retire", action="append", metavar="CATEGORY",
+                   help="retire one category (repeatable)")
+    b.add_argument("--reweight", action="append", metavar="CATEGORY=W",
+                   help="reweight one category (repeatable)")
+    b.add_argument("--note", default=None)
+    b.set_defaults(fn=cmd_bank_diff)
+
+    b = bank_sub.add_parser(
+        "log", help="lineage of a version (root first) + the ACTIVE pointer"
+    )
+    b.add_argument("--store", required=True)
+    b.add_argument("version", nargs="?", default=None)
+    b.set_defaults(fn=cmd_bank_log)
+
+    b = bank_sub.add_parser(
+        "shadow",
+        help="offline shadow: replay a journaled predict_file output "
+        "against a candidate version; writes shadow_deltas.jsonl + the "
+        "gate-consumable summary",
+    )
+    b.add_argument("--store", required=True)
+    b.add_argument("--candidate", required=True, help="store version id")
+    b.add_argument("--archive", required=True,
+                   help="model.tar.gz or its serialization dir")
+    b.add_argument("--corpus", required=True,
+                   help="the corpus file the recorded run scored")
+    b.add_argument("--results", required=True,
+                   help="the recorded run's <name>_result.json output")
+    b.add_argument("-o", "--out-dir", required=True)
+    b.add_argument("--split", default=None)
+    b.add_argument("--threshold", type=float, default=0.5)
+    b.add_argument("--overrides", default=None)
+    b.set_defaults(fn=cmd_bank_shadow)
+
+    b = bank_sub.add_parser(
+        "promote",
+        help="gated promotion check: golden-set AUC/F1 parity + shadow "
+        "flip-rate thresholds; prints the machine-readable decision "
+        "(exit 0 approved / 1 refused)",
+    )
+    b.add_argument("--store", required=True)
+    b.add_argument("--candidate", required=True, help="store version id")
+    b.add_argument("--archive", required=True)
+    b.add_argument("--golden-set", required=True,
+                   help="pinned labeled eval corpus for the parity check")
+    b.add_argument("--active", default=None,
+                   help="store version to gate against (default: the "
+                   "ACTIVE pointer, else the candidate's parent)")
+    b.add_argument("--shadow-summary", default=None,
+                   help="shadow summary JSON (bank shadow / ShadowScorer)")
+    b.add_argument("--no-shadow", action="store_true",
+                   help="gate on golden-set parity alone")
+    b.add_argument("--apply", action="store_true",
+                   help="advance the store ACTIVE pointer on approval")
+    b.add_argument("--split", default=None)
+    b.add_argument("--max-auc-drop", type=float, default=0.01)
+    b.add_argument("--max-f1-drop", type=float, default=0.01)
+    b.add_argument("--max-flip-rate", type=float, default=0.02)
+    b.add_argument("--min-shadow-samples", type=int, default=100)
+    b.add_argument("--overrides", default=None)
+    b.set_defaults(fn=cmd_bank_promote)
 
     p = sub.add_parser(
         "telemetry-report",

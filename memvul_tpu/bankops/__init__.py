@@ -1,0 +1,64 @@
+"""Anchor-bank lifecycle subsystem (docs/anchor_bank.md).
+
+MemVul's external CWE memory is the system's no-retrain update lever;
+this package makes the bank a managed, evolvable artifact instead of a
+static JSON file:
+
+* **store** — immutable versioned on-disk bank artifacts with sha256
+  manifests and full diff lineage (``add``/``retire``/``reweight``/
+  ``edit``), an ``ACTIVE`` pointer, and a promotions audit trail;
+* **shadow** — score live or journaled traffic against a candidate
+  bank off the hot path; per-request deltas stream to
+  ``shadow_deltas.jsonl``;
+* **promote** — AUC/F1-parity + shadow-flip-rate gate with
+  machine-readable refusals, fleet install via ``rolling_swap``,
+  demote-to-parent rollback;
+* **drift** — per-anchor win-share attribution and total-variation
+  drift against a pinned baseline (``bank.anchor_drift``), rendered as
+  the ``telemetry-report`` per-anchor table.
+
+CLI: ``python -m memvul_tpu bank {build,diff,log,shadow,promote}``.
+"""
+
+from .store import (  # noqa: F401
+    ACTIVE_NAME,
+    ANCHORS_NAME,
+    DIFF_OPS,
+    MANIFEST_NAME,
+    PROMOTIONS_NAME,
+    BankDiff,
+    BankIntegrityError,
+    BankStore,
+    BankStoreError,
+    DiffOp,
+    anchor_sha256,
+    canonical_anchor_text,
+)
+from .shadow import (  # noqa: F401
+    SHADOW_DELTAS_NAME,
+    ShadowConfig,
+    ShadowScorer,
+    replay_results,
+    score_texts,
+)
+from .promote import (  # noqa: F401
+    GateThresholds,
+    PromotionDecision,
+    PromotionRefused,
+    demote,
+    evaluate_candidate,
+    evaluate_gate,
+    golden_metrics,
+    promote,
+)
+from .drift import (  # noqa: F401
+    BASELINE_NAME,
+    DRIFT_GAUGE,
+    DriftMonitor,
+    load_baseline,
+    pin_baseline,
+    total_variation,
+    update_drift_gauge,
+    win_counts,
+    win_shares,
+)

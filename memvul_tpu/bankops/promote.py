@@ -1,0 +1,323 @@
+"""Gated promotion — a candidate bank earns its way into serving.
+
+The promotion gate is the contract between "someone derived a new bank
+version" and "millions of users are scored by it".  A candidate must
+pass BOTH checks before :func:`promote` will install it:
+
+* **golden-set parity** — the active and candidate banks each score a
+  pinned labeled golden set through the same warmed predictor
+  (``bankops/shadow.py:score_texts``); the candidate's AUC/F1 may not
+  drop more than the configured tolerances;
+* **shadow evidence** — a shadow summary (online
+  :class:`~memvul_tpu.bankops.shadow.ShadowScorer` or offline
+  :func:`~memvul_tpu.bankops.shadow.replay_results`) must cover at
+  least ``min_shadow_samples`` requests with a decision-flip rate at or
+  under ``max_flip_rate``.
+
+Refusals are **machine-readable**: a :class:`PromotionDecision` carries
+one ``{"code", "observed", "limit"}`` record per violated gate, so a
+rollout controller can branch on ``code`` instead of parsing prose.
+
+:func:`promote` installs an approved candidate through the PR 6 fleet
+path — ``rolling_swap`` for a :class:`ReplicaRouter` (every response
+carries exactly one bank version throughout), plain ``swap_bank`` for a
+single service — stamping provenance (``source="promotion"``, the store
+version id) into the serving manifest, then advances the store's
+``ACTIVE`` pointer and appends the audit record.  :func:`demote` is the
+rollback: re-install the active store version's *parent* the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from ..telemetry import get_registry
+from ..training.metrics import SiameseMeasure
+from .shadow import score_texts
+from .store import BankStore, BankStoreError
+
+logger = logging.getLogger(__name__)
+
+# machine-readable refusal codes (docs/anchor_bank.md)
+REASON_AUC = "auc_regression"
+REASON_F1 = "f1_regression"
+REASON_FLIP_RATE = "flip_rate_exceeded"
+REASON_SHADOW_SAMPLES = "insufficient_shadow_samples"
+REASON_SHADOW_MISSING = "shadow_evidence_missing"
+
+
+@dataclasses.dataclass(frozen=True)
+class GateThresholds:
+    """Promotion-gate tolerances; defaults mirror
+    ``config.BANKOPS_DEFAULTS``."""
+
+    max_auc_drop: float = 0.01
+    max_f1_drop: float = 0.01
+    max_flip_rate: float = 0.02
+    min_shadow_samples: int = 100
+    require_shadow: bool = True
+
+
+@dataclasses.dataclass
+class PromotionDecision:
+    """The gate's verdict.  ``reasons`` is empty iff ``approved``."""
+
+    approved: bool
+    candidate: Optional[str]
+    parent: Optional[str]
+    reasons: List[Dict[str, Any]]
+    metrics: Dict[str, Any]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "approved": self.approved,
+            "candidate": self.candidate,
+            "parent": self.parent,
+            "reasons": self.reasons,
+            "metrics": self.metrics,
+        }
+
+
+class PromotionRefused(RuntimeError):
+    """Raised by :func:`promote` on an unapproved decision; carries the
+    machine-readable decision."""
+
+    def __init__(self, decision: PromotionDecision) -> None:
+        codes = [r.get("code") for r in decision.reasons]
+        super().__init__(f"promotion refused: {codes}")
+        self.decision = decision
+
+
+def golden_metrics(
+    predictor,
+    bank_instances: Iterable[Dict],
+    eval_instances: Iterable[Dict],
+) -> Dict[str, float]:
+    """Threshold-swept siamese metrics of one bank over a labeled
+    golden set, scored through the predictor's warmed program (a
+    new-geometry bank is AOT-warmed first — the gate never costs a
+    serving process a mid-serve compile)."""
+    bank, _labels, n_anchors = predictor.encode_bank(list(bank_instances))
+    predictor.warmup_bank_shapes(bank)
+    instances = list(eval_instances)
+    probs = score_texts(
+        predictor, [inst["text1"] for inst in instances], bank, n_anchors
+    )
+    measure = SiameseMeasure()
+    measure.update(
+        probs.max(axis=-1) if len(instances) else np.zeros((0,)),
+        [inst.get("meta") or {} for inst in instances],
+    )
+    out = measure.compute(reset=True)
+    out["n_eval"] = float(len(instances))
+    return out
+
+
+def evaluate_gate(
+    active_metrics: Dict[str, float],
+    candidate_metrics: Dict[str, float],
+    shadow_summary: Optional[Dict[str, Any]],
+    thresholds: Optional[GateThresholds] = None,
+    candidate: Optional[str] = None,
+    parent: Optional[str] = None,
+) -> PromotionDecision:
+    """Pure gate logic over already-computed evidence (deterministic,
+    directly testable).  ``shadow_summary`` is the dict
+    ``ShadowScorer.stop()`` / ``replay_results`` return."""
+    thresholds = thresholds or GateThresholds()
+    reasons: List[Dict[str, Any]] = []
+
+    auc_drop = float(active_metrics.get("auc", 0.0)) - float(
+        candidate_metrics.get("auc", 0.0)
+    )
+    if auc_drop > thresholds.max_auc_drop:
+        reasons.append({
+            "code": REASON_AUC,
+            "observed": round(auc_drop, 6),
+            "limit": thresholds.max_auc_drop,
+        })
+    f1_drop = float(active_metrics.get("f1", 0.0)) - float(
+        candidate_metrics.get("f1", 0.0)
+    )
+    if f1_drop > thresholds.max_f1_drop:
+        reasons.append({
+            "code": REASON_F1,
+            "observed": round(f1_drop, 6),
+            "limit": thresholds.max_f1_drop,
+        })
+
+    if shadow_summary is None:
+        if thresholds.require_shadow:
+            reasons.append({
+                "code": REASON_SHADOW_MISSING,
+                "observed": None,
+                "limit": thresholds.min_shadow_samples,
+            })
+    else:
+        sampled = int(shadow_summary.get("sampled", 0))
+        if sampled < thresholds.min_shadow_samples:
+            reasons.append({
+                "code": REASON_SHADOW_SAMPLES,
+                "observed": sampled,
+                "limit": thresholds.min_shadow_samples,
+            })
+        flip_rate = float(shadow_summary.get("flip_rate", 0.0))
+        if flip_rate > thresholds.max_flip_rate:
+            reasons.append({
+                "code": REASON_FLIP_RATE,
+                "observed": round(flip_rate, 6),
+                "limit": thresholds.max_flip_rate,
+            })
+
+    return PromotionDecision(
+        approved=not reasons,
+        candidate=candidate,
+        parent=parent,
+        reasons=reasons,
+        metrics={
+            "active": dict(active_metrics),
+            "candidate": dict(candidate_metrics),
+            "shadow": dict(shadow_summary) if shadow_summary else None,
+        },
+    )
+
+
+def evaluate_candidate(
+    predictor,
+    store: BankStore,
+    candidate: str,
+    eval_instances: Iterable[Dict],
+    active: Optional[str] = None,
+    shadow_summary: Optional[Dict[str, Any]] = None,
+    thresholds: Optional[GateThresholds] = None,
+) -> PromotionDecision:
+    """Run the full gate for a store candidate: golden-set metrics for
+    the active version (``ACTIVE`` pointer, else the candidate's
+    parent) and the candidate, then :func:`evaluate_gate` with the
+    shadow evidence."""
+    manifest = store.manifest(candidate)
+    if active is None:
+        pointer = store.active()
+        active = (
+            pointer["version"] if pointer else manifest.get("parent")
+        )
+    if active is None:
+        raise BankStoreError(
+            f"candidate {candidate} has no parent and no ACTIVE pointer "
+            "to gate against"
+        )
+    eval_instances = list(eval_instances)
+    active_metrics = golden_metrics(
+        predictor, store.instances(active), eval_instances
+    )
+    candidate_metrics = golden_metrics(
+        predictor, store.instances(candidate), eval_instances
+    )
+    return evaluate_gate(
+        active_metrics,
+        candidate_metrics,
+        shadow_summary,
+        thresholds=thresholds,
+        candidate=candidate,
+        parent=active,
+    )
+
+
+def _install(target, instances: List[Dict], source: str, store_version: str) -> int:
+    """Install a bank on a single service or roll it across a fleet —
+    the PR 6 path, so the no-torn-version invariant holds throughout."""
+    if hasattr(target, "replicas"):
+        from ..serving.router import rolling_swap
+
+        return rolling_swap(
+            target, instances, source=source, store_version=store_version
+        )
+    return target.swap_bank(
+        instances, source=source, store_version=store_version
+    )
+
+
+def promote(
+    target,
+    store: BankStore,
+    decision: PromotionDecision,
+    registry=None,
+) -> int:
+    """Install an approved candidate into serving and advance the
+    store's ``ACTIVE`` pointer + audit trail.  Raises
+    :class:`PromotionRefused` (carrying the machine-readable decision)
+    when the gate did not approve.  Returns the new serving bank
+    version number."""
+    tel = registry if registry is not None else get_registry()
+    if not decision.approved:
+        store.record_promotion(
+            kind="promotion_refused", **decision.to_json()
+        )
+        tel.counter("bank.promotions_refused").inc()
+        raise PromotionRefused(decision)
+    if decision.candidate is None:
+        raise BankStoreError("decision names no candidate version")
+    serving_version = _install(
+        target,
+        store.instances(decision.candidate),
+        source="promotion",
+        store_version=decision.candidate,
+    )
+    store.set_active(decision.candidate, source="promotion")
+    store.record_promotion(
+        kind="promotion",
+        candidate=decision.candidate,
+        parent=decision.parent,
+        serving_version=serving_version,
+        reasons=decision.reasons,
+    )
+    tel.counter("bank.promotions").inc()
+    tel.event(
+        "bank_promotion",
+        candidate=decision.candidate,
+        serving_version=serving_version,
+    )
+    logger.info(
+        "bank %s promoted to serving v%d", decision.candidate, serving_version
+    )
+    return serving_version
+
+
+def demote(target, store: BankStore, registry=None) -> Dict[str, Any]:
+    """Roll serving back to the active store version's parent (the
+    demote-to-parent rollback): install the parent bank through the
+    same fleet path, repoint ``ACTIVE``, append the audit record.
+    Returns ``{"version": parent_id, "serving_version": int}``."""
+    tel = registry if registry is not None else get_registry()
+    pointer = store.active()
+    if pointer is None:
+        raise BankStoreError("no ACTIVE pointer — nothing to demote from")
+    current = pointer["version"]
+    parent = store.manifest(current).get("parent")
+    if parent is None:
+        raise BankStoreError(
+            f"active bank {current} is a root version — no parent to "
+            "demote to"
+        )
+    serving_version = _install(
+        target, store.instances(parent),
+        source="demotion", store_version=parent,
+    )
+    store.set_active(parent, source="demotion")
+    store.record_promotion(
+        kind="demotion",
+        demoted=current,
+        restored=parent,
+        serving_version=serving_version,
+    )
+    tel.counter("bank.demotions").inc()
+    tel.event("bank_demotion", demoted=current, restored=parent)
+    logger.info(
+        "bank %s demoted — %s restored at serving v%d",
+        current, parent, serving_version,
+    )
+    return {"version": parent, "serving_version": serving_version}

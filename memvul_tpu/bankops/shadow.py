@@ -1,0 +1,445 @@
+"""Shadow scoring — evaluate a candidate bank against real traffic.
+
+A candidate bank (a :class:`~memvul_tpu.bankops.store.BankStore`
+version, or any anchor-instance list) must prove itself against the
+traffic the active bank actually serves before promotion
+(bankops/promote.py).  Two modes, one delta-row format:
+
+* **online** (:class:`ShadowScorer`) — attach to a live
+  :class:`~memvul_tpu.serving.ScoringService` (or a
+  :class:`~memvul_tpu.serving.ReplicaRouter`, which fans the tap out to
+  every replica).  The service's shadow tap fires on the batcher thread
+  but only *enqueues* copies of sampled served requests into a bounded
+  queue; this module's own worker thread re-scores them through the
+  predictor's already-warmed score program against an immutable
+  candidate snapshot.  The hot path is untouched: active responses are
+  bitwise-identical with the shadow on or off, ``score_trace_count``
+  stays flat (a candidate of new geometry is AOT-warmed at attach
+  time, off the request path), and a crashing shadow worker only ever
+  increments ``bank.shadow_errors`` — clients cannot observe it
+  (chaos-pinned via the ``bank.shadow`` fault point);
+* **offline** (:func:`replay_results`) — replay a journaled
+  ``predict_file`` output (the PR 2 resumable scoring artifact) against
+  the candidate: stream the same corpus, score it with the candidate
+  bank, and diff row-by-row against the recorded active scores.
+
+Both stream per-request delta rows to ``shadow_deltas.jsonl`` (one row
+per shadow-scored request — the ``bank.shadow_sampled`` counter equals
+the row count exactly) and return the same summary dict the promotion
+gate consumes: sampled count, decision-flip rate at the serving
+threshold, mean/max absolute score delta.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..data.batching import _pad_block
+from ..resilience import faults
+from ..telemetry import get_registry
+from ..telemetry.sinks import JsonlSink
+from .drift import update_drift_gauge
+
+logger = logging.getLogger(__name__)
+
+SHADOW_DELTAS_NAME = "shadow_deltas.jsonl"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShadowConfig:
+    """Shadow sampling knobs; defaults mirror ``config.BANKOPS_DEFAULTS``."""
+
+    sample_stride: int = 1     # shadow-score every Nth served request
+    max_queue: int = 512       # bounded sample queue; overflow drops + counts
+    threshold: float = 0.5     # serving decision threshold (flip detection)
+    drift_every: int = 50      # update the drift gauge every N samples
+
+
+def score_texts(
+    predictor,
+    texts: Sequence[str],
+    bank_array,
+    n_anchors: int,
+) -> np.ndarray:
+    """Score ``texts`` against an *explicit* bank through the
+    predictor's warmed score program — the same bucket routing and
+    ``_pad_block`` padding the serving micro-batcher uses, so a shadow
+    score of a request is bitwise what the candidate bank *would have
+    served* for it.  Returns ``[len(texts), n_anchors]`` probabilities.
+
+    Dispatches only the predictor's warmed ``stream_shapes``; callers
+    warm a new-geometry bank via ``warmup_bank_shapes`` first (the
+    shadow/gate attach paths do), keeping ``score_trace_count`` flat.
+    """
+    if not texts:
+        return np.zeros((0, n_anchors), np.float32)
+    from ..parallel.mesh import shard_batch
+
+    rows_by_length = {
+        length: rows for rows, length in predictor.stream_shapes()
+    }
+    lengths = sorted(rows_by_length)
+    seqs = predictor.encoder.encode_many(list(texts))
+    out = np.zeros((len(texts), n_anchors), np.float32)
+    groups: Dict[int, List[int]] = {}
+    for i, seq in enumerate(seqs):
+        n_tokens = len(seq)
+        length = next((b for b in lengths if b >= n_tokens), lengths[-1])
+        groups.setdefault(length, []).append(i)
+    for length in sorted(groups):
+        rows = rows_by_length[length]
+        indices = groups[length]
+        for start in range(0, len(indices), rows):
+            chunk = indices[start : start + rows]
+            sample = _pad_block(
+                [seqs[i] for i in chunk], rows,
+                predictor.encoder.pad_id, length,
+            )
+            if predictor.mesh is not None:
+                sample = shard_batch(sample, predictor.mesh)
+            dev = predictor._score_fn(predictor.params, sample, bank_array)
+            probs = np.asarray(dev)[: len(chunk), :n_anchors]
+            for row, i in zip(probs, chunk):
+                out[i] = row
+    return out
+
+
+def _delta_row(
+    index: int,
+    active_score: float,
+    active_anchor: Optional[str],
+    active_version: Any,
+    shadow_row: np.ndarray,
+    labels: Sequence[str],
+    candidate_version: Any,
+    threshold: float,
+) -> Dict[str, Any]:
+    best = int(np.argmax(shadow_row))
+    shadow_score = float(shadow_row[best])
+    return {
+        "i": index,
+        "active_version": active_version,
+        "candidate_version": candidate_version,
+        "active_score": float(active_score),
+        "shadow_score": shadow_score,
+        "delta": shadow_score - float(active_score),
+        "active_anchor": active_anchor,
+        "shadow_anchor": labels[best],
+        "flip": (float(active_score) >= threshold) != (shadow_score >= threshold),
+    }
+
+
+class _DeltaStats:
+    """Running aggregate over emitted delta rows (the summary the
+    promotion gate reads)."""
+
+    def __init__(self) -> None:
+        self.sampled = 0
+        self.flips = 0
+        self.anchor_changes = 0
+        self.abs_delta_sum = 0.0
+        self.abs_delta_max = 0.0
+
+    def update(self, row: Dict[str, Any]) -> None:
+        self.sampled += 1
+        if row["flip"]:
+            self.flips += 1
+        if row["active_anchor"] != row["shadow_anchor"]:
+            self.anchor_changes += 1
+        a = abs(row["delta"])
+        self.abs_delta_sum += a
+        self.abs_delta_max = max(self.abs_delta_max, a)
+
+    def summary(self) -> Dict[str, Any]:
+        n = self.sampled
+        return {
+            "sampled": n,
+            "flips": self.flips,
+            "flip_rate": self.flips / n if n else 0.0,
+            "anchor_changes": self.anchor_changes,
+            "mean_abs_delta": self.abs_delta_sum / n if n else 0.0,
+            "max_abs_delta": self.abs_delta_max,
+        }
+
+
+class ShadowScorer:
+    """Online shadow: re-score sampled served requests against a
+    candidate bank, off the hot path (module docstring).
+
+    ``target`` is a :class:`ScoringService` or :class:`ReplicaRouter`;
+    the candidate is encoded (and, if its padded geometry differs from
+    the active bank's, AOT-warmed) at construction — all before the tap
+    is installed, so attaching never costs the request path a compile.
+    """
+
+    def __init__(
+        self,
+        target,
+        candidate_instances: Iterable[Dict],
+        out_dir: Optional[Union[str, Path]] = None,
+        config: Optional[ShadowConfig] = None,
+        registry=None,
+        candidate_version: Optional[str] = None,
+        baseline: Optional[Dict[str, float]] = None,
+    ) -> None:
+        self.config = config or ShadowConfig()
+        if self.config.sample_stride < 1:
+            raise ValueError("sample_stride must be >= 1")
+        self._tel = registry if registry is not None else get_registry()
+        self._target = target
+        self._baseline = baseline
+        service = (
+            target.replicas[0].service
+            if hasattr(target, "replicas") else target
+        )
+        self.predictor = service.predictor
+        self.candidate_version = candidate_version
+        bank, labels, n_anchors = self.predictor.encode_bank(
+            list(candidate_instances)
+        )
+        active = service.bank_snapshot()
+        if tuple(bank.shape) != tuple(active.array.shape):
+            # a new-geometry candidate means new XLA programs; compile
+            # them here, before the tap exists, so the batcher never
+            # traces on our account (score_trace_count stays flat)
+            self.predictor.warmup_bank_shapes(bank)
+        self._bank = bank
+        self._labels: Tuple[str, ...] = tuple(labels)
+        self._n_anchors = n_anchors
+        self._sink = (
+            JsonlSink(Path(out_dir) / SHADOW_DELTAS_NAME)
+            if out_dir is not None else None
+        )
+        self._stats = _DeltaStats()
+        self._queue: "collections.deque" = collections.deque()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._seen = 0  # tap-side request counter (stride sampling)
+        self._thread = threading.Thread(
+            target=self._worker, name="memvul-bank-shadow", daemon=True
+        )
+        self._thread.start()
+        target.set_shadow_tap(self._tap)
+
+    # -- tap (batcher thread: enqueue only, never score) -----------------------
+
+    def _tap(self, texts: List[str], probs: np.ndarray, bank) -> None:
+        # runs on the (or, behind a router, *a*) batcher thread: enqueue
+        # copies only, under the one condition lock — a fleet fans this
+        # tap out to N batcher threads, so the sample counter and queue
+        # must be guarded together
+        stride = self.config.sample_stride
+        with self._cond:
+            appended = False
+            for text, row in zip(texts, probs):
+                self._seen += 1
+                if (self._seen - 1) % stride:
+                    continue
+                if len(self._queue) >= self.config.max_queue:
+                    self._tel.counter("bank.shadow_dropped").inc()
+                    continue
+                best = int(np.argmax(row))
+                self._queue.append((
+                    text, float(row[best]), bank.labels[best], bank.version,
+                ))
+                appended = True
+            if appended:
+                self._cond.notify()
+
+    # -- worker (shadow thread: scoring + delta emission) ----------------------
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop.is_set():
+                    self._cond.wait(0.05)
+                if not self._queue and self._stop.is_set():
+                    return
+                batch = []
+                while self._queue and len(batch) < 64:
+                    batch.append(self._queue.popleft())
+            try:
+                # chaos hook: a crashing shadow scorer must only ever
+                # surface here — counted, never client-visible
+                faults.fault_point("bank.shadow")
+                rows = score_texts(
+                    self.predictor,
+                    [text for text, _, _, _ in batch],
+                    self._bank,
+                    self._n_anchors,
+                )
+            except Exception as e:
+                self._tel.counter("bank.shadow_errors").inc(len(batch))
+                logger.warning(
+                    "shadow scoring failed for %d sample(s) (active path "
+                    "unaffected): %s", len(batch), str(e)[:200],
+                )
+                continue
+            for (text, a_score, a_anchor, a_version), row in zip(batch, rows):
+                record = _delta_row(
+                    self._stats.sampled, a_score, a_anchor, a_version,
+                    row, self._labels, self.candidate_version,
+                    self.config.threshold,
+                )
+                self._stats.update(record)
+                self._tel.counter("bank.shadow_sampled").inc()
+                if record["flip"]:
+                    self._tel.counter("bank.shadow_flips").inc()
+                self._tel.histogram("bank.shadow_abs_delta").observe(
+                    abs(record["delta"])
+                )
+                if self._sink is not None:
+                    self._sink.emit(record)
+            if (
+                self._baseline
+                and self._stats.sampled
+                and self._stats.sampled % max(1, self.config.drift_every) == 0
+            ):
+                update_drift_gauge(self._tel, self._baseline)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        out = self._stats.summary()
+        out.update(
+            candidate_version=self.candidate_version,
+            dropped=self._tel.counter("bank.shadow_dropped").value,
+            errors=self._tel.counter("bank.shadow_errors").value,
+        )
+        return out
+
+    def stop(self, timeout: float = 10.0) -> Dict[str, Any]:
+        """Detach the tap, drain the sample queue, stop the worker and
+        close the delta sink.  Returns the final summary."""
+        self._target.clear_shadow_tap()
+        self._stop.set()
+        with self._cond:
+            self._cond.notify()
+        self._thread.join(timeout)
+        if self._sink is not None:
+            self._sink.close()
+        summary = self.summary()
+        self._tel.event("shadow_stop", **{
+            k: v for k, v in summary.items() if not isinstance(v, dict)
+        })
+        return summary
+
+
+def replay_results(
+    predictor,
+    candidate_instances: Iterable[Dict],
+    reader,
+    corpus_path: Union[str, Path],
+    results_path: Union[str, Path],
+    out_dir: Optional[Union[str, Path]] = None,
+    split: Optional[str] = None,
+    threshold: float = 0.5,
+    candidate_version: Optional[str] = None,
+    batch: int = 64,
+    registry=None,
+) -> Dict[str, Any]:
+    """Offline shadow: diff a candidate bank against a journaled
+    ``predict_file`` run.
+
+    Streams ``corpus_path`` through ``reader``, scores every report
+    against the candidate bank, and joins each report with its active
+    score recorded in ``results_path`` (the JSON-lines output
+    ``predict_file`` wrote and its PR 2 journal verified).  The join is
+    by ``Issue_Url`` when every recorded row carries one — a bucketed
+    recorded run writes rows in length-bucket order, not stream order —
+    with a positional fallback for url-less corpora (repeated urls
+    consume their records in recorded order).  Emits the same
+    ``shadow_deltas.jsonl`` rows as the online scorer and returns the
+    same summary dict.
+    """
+    import json as _json
+
+    tel = registry if registry is not None else get_registry()
+    results_path = Path(results_path)
+    recorded: List[Dict[str, Any]] = []
+    for line in results_path.read_text(encoding="utf-8").splitlines():
+        line = line.strip()
+        if line:
+            recorded.extend(_json.loads(line))
+    by_url: Optional[Dict[Any, List[Dict[str, Any]]]] = None
+    if recorded and all(rec.get("Issue_Url") for rec in recorded):
+        by_url = {}
+        for rec in recorded:
+            by_url.setdefault(rec["Issue_Url"], []).append(rec)
+    bank, labels, n_anchors = predictor.encode_bank(list(candidate_instances))
+    predictor.warmup_bank_shapes(bank)
+    sink = (
+        JsonlSink(Path(out_dir) / SHADOW_DELTAS_NAME)
+        if out_dir is not None else None
+    )
+    stats = _DeltaStats()
+    skew = 0
+    try:
+        instances = reader.read(str(corpus_path), split=split)
+        pending: List[Tuple[int, str, Dict[str, Any]]] = []
+
+        def flush() -> None:
+            rows = score_texts(
+                predictor, [t for _, t, _ in pending], bank, n_anchors
+            )
+            for (index, _, rec), row in zip(pending, rows):
+                preds = rec.get("predict") or {}
+                active_score = max(preds.values()) if preds else 0.0
+                active_anchor = (
+                    max(preds, key=preds.get) if preds else None
+                )
+                record = _delta_row(
+                    index, active_score, active_anchor, "recorded",
+                    row, labels, candidate_version, threshold,
+                )
+                stats.update(record)
+                tel.counter("bank.shadow_sampled").inc()
+                if record["flip"]:
+                    tel.counter("bank.shadow_flips").inc()
+                if sink is not None:
+                    sink.emit(record)
+            pending.clear()
+
+        for i, inst in enumerate(instances):
+            if by_url is not None:
+                url = (inst.get("meta") or {}).get("Issue_Url")
+                queue = by_url.get(url)
+                if not queue:
+                    skew += 1
+                    continue
+                rec = queue.pop(0)
+            elif i < len(recorded):
+                rec = recorded[i]
+            else:
+                skew += 1
+                continue
+            pending.append((i, inst["text1"], rec))
+            if len(pending) >= batch:
+                flush()
+        if pending:
+            flush()
+    finally:
+        if sink is not None:
+            sink.close()
+    summary = stats.summary()
+    summary.update(
+        candidate_version=candidate_version,
+        recorded_rows=len(recorded),
+        corpus_rows_unmatched=skew,
+        mode="replay",
+    )
+    if skew:
+        logger.warning(
+            "replay: corpus has %d more row(s) than the recorded results "
+            "— the run being replayed was truncated or the corpus changed",
+            skew,
+        )
+    return summary

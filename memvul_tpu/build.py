@@ -341,7 +341,7 @@ def serve_from_archive(
     replica."""
     from . import telemetry
     from .archive import load_archive
-    from .config import serving_config, telemetry_config
+    from .config import bankops_config, serving_config, telemetry_config
     from .data.batching import validate_buckets
     from .evaluate.predict_memory import SiamesePredictor
     from .resilience.retry import RetryPolicy
@@ -401,15 +401,40 @@ def serve_from_archive(
     anchors = list(reader.read_anchors(str(golden)))
     retries = int(serve_cfg["retries"])
     retry_policy = RetryPolicy(attempts=retries) if retries > 0 else None
+    bank_cfg = bankops_config(arch.config)
     service_config = ServiceConfig(
         max_batch=int(serve_cfg["max_batch"]),
         max_wait_ms=float(serve_cfg["max_wait_ms"]),
         max_queue=int(serve_cfg["max_queue"]),
         default_deadline_ms=float(serve_cfg["default_deadline_ms"]),
+        anchor_stats=bool(bank_cfg["anchor_stats"]),
     )
     n_replicas = int(
         serve_cfg["replicas"] if replicas is None else replicas
     )
+
+    def _with_drift_monitor(target):
+        # bankops.baseline pins a win-share distribution; a background
+        # monitor republishes the bank.anchor_drift gauge from the
+        # serving counters (bankops/drift.py; docs/anchor_bank.md).
+        # Attached as an attribute so the CLI can stop it at drain.
+        baseline_path = bank_cfg["baseline"]
+        if baseline_path:
+            from .bankops.drift import DriftMonitor, load_baseline
+
+            baseline = load_baseline(baseline_path)
+            if baseline:
+                target.drift_monitor = DriftMonitor(
+                    telemetry.get_registry(),
+                    baseline,
+                    interval_s=float(bank_cfg["drift_interval_s"]),
+                )
+            else:
+                logger.warning(
+                    "bankops.baseline %s missing/unreadable — drift "
+                    "gauge disabled", baseline_path,
+                )
+        return target
 
     if n_replicas <= 1:
         if mesh is None and use_mesh and len(jax.devices()) > 1:
@@ -427,12 +452,12 @@ def serve_from_archive(
             aot_warmup=True,  # the whole point: no mid-serve compiles
         )
         predictor.encode_anchors(anchors)
-        return ScoringService(
+        return _with_drift_monitor(ScoringService(
             predictor,
             config=service_config,
             retry_policy=retry_policy,
             manifest_dir=out_dir,
-        )
+        ))
 
     # -- replica fan-out: one service per assigned local device ------------
     if mesh is not None:
@@ -487,7 +512,7 @@ def serve_from_archive(
         "replica fleet: %d service(s) over %d local device(s)",
         n_replicas, len(devices),
     )
-    return ReplicaRouter(
+    return _with_drift_monitor(ReplicaRouter(
         replica_list,
         config=RouterConfig(
             heartbeat_timeout_s=float(serve_cfg["heartbeat_timeout_s"]),
@@ -496,7 +521,7 @@ def serve_from_archive(
             max_reroutes=int(serve_cfg["max_reroutes"]),
         ),
         retry_policy=retry_policy,
-    )
+    ))
 
 
 def _auto_buckets_for_corpus(
@@ -634,6 +659,7 @@ def evaluate_from_archive(
                     quarantine=eval_cfg["quarantine"],
                     heartbeat_batches=int(eval_cfg["heartbeat_batches"]),
                     score_retries=int(eval_cfg["score_retries"]),
+                    attribute_anchors=bool(eval_cfg["attribute_anchors"]),
                 )
             from .evaluate.predict_single import test_single
 

@@ -239,6 +239,10 @@ EVALUATION_DEFAULTS: Dict[str, Any] = {
     "quarantine": False,     # dead-letter malformed/over-long records
     "heartbeat_batches": 0,  # progress log every N batches (0 = off)
     "score_retries": 0,      # transient-failure retries per batch (0 = off)
+    # add the winning anchor id/index to every output record
+    # (docs/anchor_bank.md) — off so the default output format stays
+    # byte-stable with the reference's
+    "attribute_anchors": False,
 }
 
 
@@ -337,6 +341,33 @@ SERVING_DEFAULTS: Dict[str, Any] = {
 def serving_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """``cfg["serving"]`` merged over :data:`SERVING_DEFAULTS`."""
     return _section_over_defaults(cfg, "serving", SERVING_DEFAULTS)
+
+
+# The ``bankops`` config section (docs/anchor_bank.md) — the anchor-bank
+# lifecycle subsystem: versioned store location, per-anchor win/drift
+# attribution, shadow-scoring sampling, and the promotion-gate
+# thresholds.  Read by build.serve_from_archive (attribution knob) and
+# the ``python -m memvul_tpu bank`` CLI (store/shadow/gate knobs).
+BANKOPS_DEFAULTS: Dict[str, Any] = {
+    "store_dir": None,         # versioned bank store root (bankops/store.py)
+    "anchor_stats": True,      # per-anchor win/score attribution in serving
+    "baseline": None,          # pinned anchor_baseline.json path (drift)
+    "drift_interval_s": 30.0,  # DriftMonitor gauge refresh cadence
+    # shadow scoring (bankops/shadow.py)
+    "shadow_sample_stride": 1,   # shadow-score every Nth served request
+    "shadow_max_queue": 512,     # bounded sample queue; overflow drops
+    "shadow_threshold": 0.5,     # serving decision threshold (flip detect)
+    # promotion gate (bankops/promote.py)
+    "max_auc_drop": 0.01,        # golden-set AUC tolerance
+    "max_f1_drop": 0.01,         # golden-set F1 tolerance
+    "max_flip_rate": 0.02,       # shadow decision-flip ceiling
+    "min_shadow_samples": 100,   # required shadow evidence volume
+}
+
+
+def bankops_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``cfg["bankops"]`` merged over :data:`BANKOPS_DEFAULTS`."""
+    return _section_over_defaults(cfg, "bankops", BANKOPS_DEFAULTS)
 
 
 # The ``telemetry`` config section (docs/observability.md).  Read by the

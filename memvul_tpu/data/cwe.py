@@ -255,7 +255,13 @@ def build_full_view_anchors(
 
 
 def save_anchors(anchors: Dict[str, str], path: Union[str, Path]) -> None:
-    Path(path).write_text(json.dumps(anchors, indent=2))
+    """Persist an anchor set.  Atomic (tmp + rename): the anchor JSON is
+    the artifact ``bank build`` imports into the versioned store
+    (docs/anchor_bank.md), so a killed build must never leave a torn
+    file where a digest-verified bank is about to come from."""
+    from ..resilience.io import atomic_write_text
+
+    atomic_write_text(Path(path), json.dumps(anchors, indent=2))
 
 
 def load_anchors(path: Union[str, Path]) -> Dict[str, str]:

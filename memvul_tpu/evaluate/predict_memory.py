@@ -284,9 +284,16 @@ class SiamesePredictor:
         prefetch_depth: int = 4,
         inflight: int = 2,
         retry_policy: Optional[RetryPolicy] = None,
+        with_anchors: bool = False,
     ) -> Iterator[Tuple[np.ndarray, List[Dict]]]:
         """Yields (per-report best anchor probabilities [b, A], metas) per
         batch, padding rows removed.
+
+        ``with_anchors=True`` additionally stamps each meta with the
+        winning anchor (``meta["_anchor"]`` id, ``meta["_anchor_index"]``
+        bank index) so offline attribution matches what the serving path
+        records per response (docs/anchor_bank.md).  Off by default —
+        the yielded tuple shape and metas are unchanged otherwise.
 
         The device dispatch is asynchronous: up to ``inflight`` batches are
         queued on the accelerator before the oldest result is pulled to
@@ -375,7 +382,49 @@ class SiamesePredictor:
             rows_ctr.inc(len(metas))
             tel.progress()
             # drop dead rows and any zero-padded anchor columns
-            yield arr[: len(metas), : self.n_anchors], metas
+            sliced = arr[: len(metas), : self.n_anchors]
+            if with_anchors:
+                for meta, idx in zip(metas, sliced.argmax(axis=-1)):
+                    meta["_anchor_index"] = int(idx)
+                    meta["_anchor"] = self.anchor_labels[int(idx)]
+            yield sliced, metas
+
+    def predict_single(self, text: str) -> Dict[str, Union[float, str, int, Dict]]:
+        """Score ONE report text and return the full attribution the
+        serving path returns per response: the per-anchor probability
+        dict, the max score, and the winning anchor's id + bank index.
+        Dispatches at the smallest warmed stream shape, so after
+        ``warmup_compile`` this never traces (``score_trace_count``
+        flat) — the offline twin of one served request."""
+        if self.anchor_bank is None:
+            raise RuntimeError("call encode_anchors() first")
+        from ..data.batching import _pad_block
+
+        seq = self.encoder.encode_many([text])[0]
+        # smallest warmed bucket covering the text; over-long texts
+        # truncate into the largest (the micro-batcher's _bucket_for rule)
+        shapes = sorted(self.stream_shapes(), key=lambda rl: rl[1])
+        rows, length = shapes[-1]
+        for cand_rows, cand_length in shapes:
+            if cand_length >= len(seq):
+                rows, length = cand_rows, cand_length
+                break
+        sample = _pad_block([seq], rows, self.encoder.pad_id, length)
+        if self.mesh is not None:
+            sample = shard_batch(sample, self.mesh)
+        row = np.asarray(
+            self._score_fn(self.params, sample, self.anchor_bank)
+        )[0, : self.n_anchors]
+        best = int(np.argmax(row))
+        return {
+            "predict": {
+                label: float(p)
+                for label, p in zip(self.anchor_labels, row)
+            },
+            "score": float(row[best]),
+            "anchor": self.anchor_labels[best],
+            "anchor_index": best,
+        }
 
     def predict_file(
         self,
@@ -389,6 +438,7 @@ class SiamesePredictor:
         heartbeat_batches: int = 0,
         retry_policy: Optional[RetryPolicy] = None,
         expected_reports: Optional[int] = None,
+        attribute_anchors: bool = False,
     ) -> Dict[str, float]:
         """Stream a corpus file, write the reference-format result lines,
         return the threshold-swept siamese metrics.
@@ -417,6 +467,10 @@ class SiamesePredictor:
           stalled corpus run is distinguishable from a slow one.
         * ``retry_policy`` retries transiently-failing batches
           (see :meth:`score_instances`).
+        * ``attribute_anchors=True`` adds the winning anchor's id and
+          bank index (``"anchor"``/``"anchor_index"``) to every output
+          record — flag-gated so the default output stays byte-stable
+          with the reference format.
         """
         import queue
         import threading
@@ -483,6 +537,13 @@ class SiamesePredictor:
                                     anchor: float(p)
                                     for anchor, p in zip(self.anchor_labels, row)
                                 },
+                                **(
+                                    {
+                                        "anchor": meta.get("_anchor"),
+                                        "anchor_index": meta.get("_anchor_index"),
+                                    }
+                                    if attribute_anchors else {}
+                                ),
                             }
                             for row, meta in zip(probs, metas)
                         ]
@@ -527,7 +588,8 @@ class SiamesePredictor:
         span.enter_context(tel.span("score_stream"))
         try:
             for probs, metas in self.score_instances(
-                instances, inflight=inflight, retry_policy=retry_policy
+                instances, inflight=inflight, retry_policy=retry_policy,
+                with_anchors=attribute_anchors,
             ):
                 while not failed.is_set():
                     try:
@@ -647,6 +709,7 @@ def test_siamese(
     heartbeat_batches: int = 0,
     score_retries: int = 0,
     expected_reports: Optional[int] = None,
+    attribute_anchors: bool = False,
 ) -> Dict[str, float]:
     """End-to-end evaluation mirroring the reference's ``test_siamese``
     (predict_memory.py:49-114) + ``cal_metrics`` (:159-197).
@@ -680,6 +743,7 @@ def test_siamese(
         retry_policy=RetryPolicy(attempts=score_retries)
         if score_retries > 0 else None,
         expected_reports=expected_reports,
+        attribute_anchors=attribute_anchors,
     )
     final = cal_metrics(out_results, thres=thres, out_file=out_metrics)
     final.update({f"s_{k}": v for k, v in eval_metrics.items()})

@@ -18,6 +18,10 @@ point              fires
                    hard-kills that replica with SIGKILL semantics
                    (nothing resolves, the router must sweep + re-route);
                    ``replica.kill.replica-<i>`` targets one member
+``bank.shadow``    once per shadow-scored sample batch, inside the shadow
+                   worker thread (bankops/shadow.py) — a firing lands in
+                   ``bank.shadow_errors`` and must never touch the active
+                   serving path (clients cannot observe it)
 ``step.N``         at the start of optimizer step ``N`` (global step index)
 ``kernel.lower``   when the fused Pallas anchor-match kernel is selected,
                    before it is traced (simulates a Mosaic lowering failure)

@@ -100,6 +100,10 @@ class Replica:
             enabled=telemetry_enabled,
             heartbeat_every_s=heartbeat_every_s,
         )
+        # shadow tap (bankops/shadow.py): kept here so a restart's fresh
+        # service re-attaches it — a replica death must not silently end
+        # a shadow evaluation
+        self._shadow_tap = None
         self.service = service_factory(self.registry)
         self.state = REPLICA_HEALTHY
         self.accepting.set()
@@ -135,6 +139,16 @@ class Replica:
 
     def heartbeat_age_s(self) -> float:
         return self.registry.heartbeat_age_s()
+
+    # -- shadow tap ------------------------------------------------------------
+
+    def set_shadow_tap(self, tap) -> None:
+        self._shadow_tap = tap
+        self.service.set_shadow_tap(tap)
+
+    def clear_shadow_tap(self) -> None:
+        self._shadow_tap = None
+        self.service.clear_shadow_tap()
 
     # -- death / sweep ---------------------------------------------------------
 
@@ -238,6 +252,8 @@ class Replica:
                 # account anything the dead/wedged batcher abandoned
                 self.sweep_unresolved()
             self.service = self._factory(self.registry)
+            if self._shadow_tap is not None:
+                self.service.set_shadow_tap(self._shadow_tap)
             self.restart_count += 1
             self._err_streak = 0
             self._last_batches = self.registry.counter("serve.batches").value
@@ -254,12 +270,20 @@ class Replica:
             logger.info("%s restarted (restart #%d)", self.name, self.restart_count)
 
     def install_bank(
-        self, anchor_instances: Iterable[Dict], version: Optional[int] = None
+        self,
+        anchor_instances: Iterable[Dict],
+        version: Optional[int] = None,
+        source: str = "rolling_swap",
+        store_version: Optional[str] = None,
     ) -> int:
         """Encode + pre-warm + install a bank on this replica's service
         at an explicit fleet version (the rolling-swap step; see
-        ``ScoringService.swap_bank`` for the no-torn-snapshot story)."""
-        return self.service.swap_bank(anchor_instances, version=version)
+        ``ScoringService.swap_bank`` for the no-torn-snapshot story and
+        the provenance fields)."""
+        return self.service.swap_bank(
+            anchor_instances, version=version,
+            source=source, store_version=store_version,
+        )
 
     # -- shutdown --------------------------------------------------------------
 
@@ -271,7 +295,10 @@ class Replica:
         self.registry.close()
 
     def summary(self) -> Dict[str, Any]:
-        """One /healthz row: state, backlog, liveness, lives used."""
+        """One /healthz row: state, backlog, liveness, lives used, and
+        the bank's provenance (source + store version) so fleet state is
+        traceable to a bank-store version."""
+        bank = self.service.bank_snapshot()
         return {
             "name": self.name,
             "state": self.state,
@@ -279,5 +306,7 @@ class Replica:
             "queue_depth": self.queue_depth,
             "heartbeat_age_s": round(self.heartbeat_age_s(), 3),
             "restarts": self.restart_count,
-            "bank_version": self.bank_version,
+            "bank_version": bank.version,
+            "bank_source": bank.source,
+            "bank_store_version": bank.store_version,
         }

@@ -127,8 +127,11 @@ class ReplicaRouter:
         self._swap_lock = threading.Lock()  # one rolling swap at a time
         self._active_version = max(r.bank_version for r in self.replicas)
         # the fleet's current bank content, for re-install on restart
-        # (None = the factory-built bank is still current)
+        # (None = the factory-built bank is still current), plus its
+        # provenance so a restart re-stamps the same source/store id
         self._bank_instances: Optional[List[Dict]] = None
+        self._bank_source: str = "rolling_swap"
+        self._bank_store_version: Optional[str] = None
         self._default_deadline_ms = self.replicas[0].service.default_deadline_ms
         self._recovering: Dict[str, bool] = {}
         self._monitor = threading.Thread(
@@ -156,6 +159,18 @@ class ReplicaRouter:
     @property
     def default_deadline_ms(self) -> float:
         return self._default_deadline_ms
+
+    # -- shadow tap (bankops/shadow.py) ---------------------------------------
+
+    def set_shadow_tap(self, tap) -> None:
+        """Fan one shadow tap out to every replica (each replica
+        re-attaches it across its own restarts)."""
+        for replica in self.replicas:
+            replica.set_shadow_tap(tap)
+
+    def clear_shadow_tap(self) -> None:
+        for replica in self.replicas:
+            replica.clear_shadow_tap()
 
     def health_summary(self) -> Dict[str, Any]:
         """The /healthz body for a fleet: drain state, total backlog,
@@ -455,7 +470,9 @@ def _recover_replica(router: ReplicaRouter, replica: Replica, dead: bool) -> Non
             ):
                 replica.accepting.clear()
                 replica.install_bank(
-                    router._bank_instances, version=router._active_version
+                    router._bank_instances, version=router._active_version,
+                    source=router._bank_source,
+                    store_version=router._bank_store_version,
                 )
                 replica.accepting.set()
         tel.counter("router.replica_restarts").inc()
@@ -472,6 +489,8 @@ def rolling_swap(
     anchor_instances: Iterable[Dict],
     drain_timeout_s: float = 30.0,
     poll_interval_s: float = 0.01,
+    source: str = "rolling_swap",
+    store_version: Optional[str] = None,
 ) -> int:
     """Roll a new anchor bank across the fleet, one replica at a time.
 
@@ -517,7 +536,10 @@ def rolling_swap(
                     and time.monotonic() < deadline
                 ):
                     time.sleep(poll_interval_s)
-                replica.install_bank(instances, version=target)
+                replica.install_bank(
+                    instances, version=target,
+                    source=source, store_version=store_version,
+                )
                 with replica._state_lock:
                     replica.state = previous_state
                 replica.accepting.set()
@@ -525,6 +547,8 @@ def rolling_swap(
                     "replica_swap_done", replica=replica.name, version=target
                 )
         router._bank_instances = instances
+        router._bank_source = source
+        router._bank_store_version = store_version
         router._active_version = target
     tel.counter("router.bank_swaps").inc()
     tel.gauge("router.bank_version").set(target)

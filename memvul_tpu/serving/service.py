@@ -82,6 +82,10 @@ class ServiceConfig:
     max_wait_ms: float = 5.0     # oldest-request coalescing window
     max_queue: int = 256         # bounded queue depth (admission control)
     default_deadline_ms: float = 2000.0  # per-request budget; <=0 = none
+    # per-anchor attribution: each served decision counts into
+    # bank.anchor_wins.<id> + a bank.anchor_score.<id> reservoir — the
+    # raw material of the drift table (bankops/drift.py)
+    anchor_stats: bool = True
 
 
 class ScoreFuture:
@@ -149,12 +153,21 @@ class _BankVersion:
     """One immutable anchor-bank snapshot.  ``array`` is the
     device-resident (possibly sharding-padded) bank; ``n_anchors`` the
     real row count; a micro-batch captures one snapshot and labels its
-    whole response from it — the no-torn-mix guarantee."""
+    whole response from it — the no-torn-mix guarantee.
+
+    ``source``/``parent_version``/``store_version`` are provenance:
+    where this snapshot came from (startup, a manual swap, a rolling
+    swap, or a bankops promotion), which serving version it replaced,
+    and — when it came out of a versioned bank store — which store
+    version id it is (docs/anchor_bank.md)."""
 
     version: int
     array: Any
     labels: Tuple[str, ...]
     n_anchors: int
+    source: str = "startup"
+    parent_version: Optional[int] = None
+    store_version: Optional[str] = None
 
 
 class ScoringService:
@@ -212,6 +225,11 @@ class ScoringService:
         self._killed = threading.Event()
         self._inflight: List[_Request] = []  # guarded by self._cond
         self._closed = threading.Event()
+        # shadow tap (bankops/shadow.py): called on the batcher thread
+        # AFTER a chunk's futures resolve, with copies of the served
+        # texts/probs — it may only enqueue, and a raising tap is
+        # swallowed, so active responses are bitwise-unchanged by it
+        self._shadow_tap: Optional[Any] = None
         # the replica tier gives each service its own registry so one
         # process can host N replicas with separable health/counters;
         # the single-service path keeps the process-wide default
@@ -272,6 +290,26 @@ class ScoringService:
         with self._bank_lock:
             return self._bank.labels
 
+    def bank_snapshot(self) -> _BankVersion:
+        """The current immutable bank snapshot (version + provenance) —
+        what the shadow scorer compares geometries against and the
+        health/manifest paths report."""
+        with self._bank_lock:
+            return self._bank
+
+    # -- shadow tap (bankops/shadow.py) ---------------------------------------
+
+    def set_shadow_tap(self, tap) -> None:
+        """Install ``tap(texts, probs, bank_snapshot)`` — called on the
+        batcher thread after each successfully served chunk's futures
+        resolve.  The tap must only enqueue (the shadow worker scores on
+        its own thread); exceptions are swallowed and counted so the
+        active path cannot be affected."""
+        self._shadow_tap = tap
+
+    def clear_shadow_tap(self) -> None:
+        self._shadow_tap = None
+
     @property
     def draining(self) -> bool:
         return self._draining.is_set()
@@ -296,11 +334,20 @@ class ScoringService:
         "draining" from "healthy but backed up".  The router's override
         adds the per-replica fleet view (docs/serving.md)."""
         draining = self._draining.is_set()
+        bank = self.bank_snapshot()
         return {
             "status": "draining" if draining else "ok",
             "draining": draining,
             "queue_depth": self.queue_depth,
-            "bank_version": self.bank_version,
+            "bank_version": bank.version,
+            # provenance row: fleet state is traceable to a store
+            # version + how it got installed (docs/anchor_bank.md)
+            "bank": {
+                "version": bank.version,
+                "source": bank.source,
+                "parent_version": bank.parent_version,
+                "store_version": bank.store_version,
+            },
         }
 
     # -- shutdown --------------------------------------------------------------
@@ -378,7 +425,11 @@ class ScoringService:
     # -- hot anchor-bank swap --------------------------------------------------
 
     def swap_bank(
-        self, anchor_instances: Iterable[Dict], version: Optional[int] = None
+        self,
+        anchor_instances: Iterable[Dict],
+        version: Optional[int] = None,
+        source: str = "manual",
+        store_version: Optional[str] = None,
     ) -> int:
         """Re-encode a new anchor set and atomically install it.
 
@@ -394,7 +445,13 @@ class ScoringService:
         default ``current + 1`` — the replica tier uses it so every
         member of a fleet stamps one rollout with ONE number (a
         restarted replica re-installs the fleet's bank at the fleet's
-        version; its own counter restarted at 1)."""
+        version; its own counter restarted at 1).
+
+        ``source`` and ``store_version`` are provenance, recorded in
+        the snapshot, the manifest, and the ``health_summary()`` bank
+        row: "manual" for an operator swap, "rolling_swap" for a fleet
+        rollout, "promotion"/"demotion" for the bankops gate
+        (docs/anchor_bank.md)."""
         with self._swap_lock:
             bank, labels, n_anchors = self.predictor.encode_bank(
                 anchor_instances
@@ -420,12 +477,16 @@ class ScoringService:
                     array=bank,
                     labels=tuple(labels),
                     n_anchors=n_anchors,
+                    source=source,
+                    parent_version=current.version,
+                    store_version=store_version,
                 )
                 self._bank = new
         self._tel.counter("serve.bank_swaps").inc()
         self._tel.gauge("serve.bank_version").set(new.version)
         self._tel.event(
-            "bank_swap", version=new.version, n_anchors=new.n_anchors
+            "bank_swap", version=new.version, n_anchors=new.n_anchors,
+            source=source, store_version=store_version,
         )
         self._write_manifest()
         logger.info(
@@ -455,6 +516,13 @@ class ScoringService:
                     "n_anchors": bank.n_anchors,
                     "labels_sha256": digest,
                     "labels": list(bank.labels),
+                    # provenance: which serving version this replaced,
+                    # how it was installed (manual swap vs rolling swap
+                    # vs promotion), and the bank-store version id when
+                    # it came out of one (docs/anchor_bank.md)
+                    "parent_version": bank.parent_version,
+                    "source": bank.source,
+                    "store_version": bank.store_version,
                     "written_wall": time.time(),
                 },
                 indent=2,
@@ -624,11 +692,22 @@ class ScoringService:
         tel.counter("serve.served").inc(len(chunk))
         tel.progress()
         now = time.monotonic()
+        anchor_stats = self.config.anchor_stats
         for (request, _), row in zip(chunk, probs):
             best = int(np.argmax(row))
             tel.histogram("serve.latency_s").observe(
                 now - request.enqueued_monotonic
             )
+            if anchor_stats:
+                # attribute the decision to its winning anchor — the
+                # per-anchor win/drift table's raw data (bankops/drift.py,
+                # docs/anchor_bank.md); ~one counter inc + one reservoir
+                # observe per response, bounded by the bank size
+                label = bank.labels[best]
+                tel.counter(f"bank.anchor_wins.{label}").inc()
+                tel.histogram(f"bank.anchor_score.{label}").observe(
+                    float(row[best])
+                )
             request.future.resolve({
                 "status": STATUS_OK,
                 "predict": {
@@ -641,6 +720,18 @@ class ScoringService:
                     (now - request.enqueued_monotonic) * 1e3, 3
                 ),
             })
+        tap = self._shadow_tap
+        if tap is not None:
+            # after resolution, so shadow sampling never adds to client
+            # latency; the tap only enqueues copies, and a raising tap
+            # is counted — never client-visible (bankops/shadow.py)
+            try:
+                tap([request.text for request, _ in chunk], probs, bank)
+            except Exception:
+                tel.counter("bank.shadow_errors").inc()
+                logger.exception(
+                    "shadow tap failed (active path unaffected)"
+                )
 
     # -- shed / drain resolution ----------------------------------------------
 

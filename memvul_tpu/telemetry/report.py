@@ -132,6 +132,92 @@ def _replica_section(
     return lines
 
 
+def _anchor_bank_section(
+    run_dir: Path, counters: Dict[str, Any], summary: Dict[str, Any]
+) -> List[str]:
+    """Per-anchor win/score/drift table (docs/anchor_bank.md): the
+    serving path counts ``bank.anchor_wins.<id>`` and samples
+    ``bank.anchor_score.<id>`` per served decision; a pinned
+    ``anchor_baseline.json`` beside the sinks turns win shares into a
+    drift column, so a degrading anchor is visible before it costs
+    recall.  Shadow-scoring counters render as one summary line."""
+    wins: Dict[str, float] = {}
+    for name, value in counters.items():
+        if name.startswith("bank.anchor_wins."):
+            try:
+                wins[name[len("bank.anchor_wins."):]] = float(value)
+            except (TypeError, ValueError):
+                continue
+    shadow = {
+        key: counters.get(f"bank.shadow_{key}", 0)
+        for key in ("sampled", "flips", "errors", "dropped")
+    }
+    has_shadow = any(_as_num(v) for v in shadow.values())
+    if not (wins or has_shadow):
+        return []
+    lines = ["ANCHOR BANK"]
+    if wins:
+        total = sum(wins.values())
+        hists = summary.get("histograms") or {}
+        baseline = None
+        try:
+            from ..bankops.drift import load_baseline
+
+            baseline = load_baseline(run_dir / "anchor_baseline.json")
+        except Exception:  # pragma: no cover - report must always render
+            baseline = None
+        gauges = summary.get("gauges") or {}
+        drift_line = f"  decisions: {int(total)}"
+        if gauges.get("bank.anchor_drift") is not None:
+            drift_line += (
+                f"  drift(gauge): {_fmt_num(gauges['bank.anchor_drift'])}"
+            )
+        if baseline and total > 0:
+            shares = {k: v / total for k, v in wins.items()}
+            keys = set(shares) | set(baseline)
+            tv = 0.5 * sum(
+                abs(shares.get(k, 0.0) - baseline.get(k, 0.0)) for k in keys
+            )
+            drift_line += f"  drift(vs baseline): {tv:.3f}"
+        lines.append(drift_line)
+        lines.append(
+            f"  {'anchor':<24} {'wins':>8} {'share':>7} {'score p50':>10}"
+            f" {'score max':>10}" + ("  Δshare" if baseline else "")
+        )
+        ranked = sorted(wins, key=lambda a: -wins[a])
+        for anchor in ranked[:20]:
+            count = wins[anchor]
+            share = count / total if total else 0.0
+            h = hists.get(f"bank.anchor_score.{anchor}") or {}
+            row = (
+                f"  {anchor:<24} {int(count):>8} {share:>6.1%}"
+                f" {_fmt_num(h.get('p50', '-')):>10}"
+                f" {_fmt_num(h.get('max', '-')):>10}"
+            )
+            if baseline:
+                row += f"  {share - baseline.get(anchor, 0.0):+.3f}"
+            lines.append(row)
+        if len(ranked) > 20:
+            lines.append(f"  (+{len(ranked) - 20} more anchors)")
+    if has_shadow:
+        sampled = _as_num(shadow["sampled"])
+        flips = _as_num(shadow["flips"])
+        lines.append(
+            f"  shadow: sampled={int(sampled)} flips={int(flips)}"
+            + (f" flip_rate={flips / sampled:.4f}" if sampled else "")
+            + f" errors={int(_as_num(shadow['errors']))}"
+            + f" dropped={int(_as_num(shadow['dropped']))}"
+        )
+    return lines
+
+
+def _as_num(v: Any) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
 def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str:
     """The human summary as one string (the CLI prints it verbatim)."""
     data = load_run(run_dir)
@@ -264,6 +350,12 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
         lines.append("GAUGES")
         for name in sorted(gauges):
             lines.append(f"  {name} = {_fmt_num(gauges[name])}")
+
+    # -- anchor bank (per-anchor wins / drift / shadow) ------------------------
+    anchor_lines = _anchor_bank_section(data["run_dir"], counters, summary)
+    if anchor_lines:
+        lines.append("")
+        lines.extend(anchor_lines)
 
     # -- replicas (scale-out serving runs) ------------------------------------
     replica_lines = _replica_section(data["run_dir"], events, now)

@@ -1,0 +1,875 @@
+"""Anchor-bank lifecycle subsystem (memvul_tpu/bankops/,
+docs/anchor_bank.md).
+
+The acceptance contract this file pins:
+
+* **store** — versions are immutable, digest-verified, and lineage-
+  complete: every non-root version records its parent and the exact
+  diff ops; a tampered artifact raises, a crash remnant is invisible;
+* **shadow** — with a shadow scorer attached, active responses are
+  BITWISE-identical to a no-shadow run, ``score_trace_count`` stays
+  flat under load, and ``shadow_deltas.jsonl`` carries exactly one row
+  per sampled request; a crashing shadow worker (the ``bank.shadow``
+  fault point) lands in ``bank.shadow_errors`` and clients never see
+  it — the serve counter invariant is untouched;
+* **promotion** — the gate refuses a bad candidate with a
+  machine-readable reason and promotes a good one through the PR 6
+  ``rolling_swap`` (every response stamped with exactly one bank
+  version; provenance recorded store→manifest→/healthz); ``demote``
+  restores the parent;
+* **observability** — per-anchor win/drift telemetry renders as a
+  table in ``telemetry-report``;
+* **lint** — bankops/ writes artifacts only through
+  ``atomic_write_text``/``JsonlSink`` (tools/lint_bank_artifact_writes).
+"""
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu import telemetry
+from memvul_tpu.bankops import (
+    BankDiff,
+    BankIntegrityError,
+    BankStore,
+    BankStoreError,
+    GateThresholds,
+    PromotionRefused,
+    ShadowConfig,
+    ShadowScorer,
+    demote,
+    evaluate_gate,
+    golden_metrics,
+    pin_baseline,
+    promote,
+    replay_results,
+    total_variation,
+    update_drift_gauge,
+    win_shares,
+)
+from memvul_tpu.bankops.promote import (
+    REASON_AUC,
+    REASON_FLIP_RATE,
+    REASON_SHADOW_MISSING,
+    REASON_SHADOW_SAMPLES,
+    PromotionDecision,
+)
+from memvul_tpu.bankops.shadow import SHADOW_DELTAS_NAME
+from memvul_tpu.data.cwe import load_anchors
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.resilience import faults
+from memvul_tpu.serving import (
+    MANIFEST_NAME,
+    Replica,
+    ReplicaRouter,
+    RouterConfig,
+    ScoringService,
+    ServiceConfig,
+)
+from memvul_tpu.telemetry.report import render_report
+from memvul_tpu.telemetry.sinks import read_jsonl
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.reset()
+    telemetry.reset()
+
+
+# -- store ---------------------------------------------------------------------
+
+ANCHORS_V1 = {
+    "CWE-79": "cross site scripting description",
+    "CWE-89": "sql injection description",
+    "CWE-22": "path traversal description",
+}
+
+
+def test_store_create_derive_lineage(tmp_path):
+    store = BankStore(tmp_path / "banks")
+    m1 = store.create(ANCHORS_V1, source="build", note="seed bank")
+    assert m1["version"] == "v1" and m1["parent"] is None
+    assert m1["n_anchors"] == 3 and m1["diff"] == []
+    diff = BankDiff.from_json([
+        {"op": "add", "category": "CWE-502",
+         "description": "deserialization of untrusted data"},
+        {"op": "retire", "category": "CWE-89"},
+        {"op": "reweight", "category": "CWE-79", "weight": 2.0},
+    ])
+    m2 = store.derive("v1", diff, note="rotate")
+    assert m2["version"] == "v2" and m2["parent"] == "v1"
+    anchors = store.anchors("v2")
+    assert "CWE-502" in anchors and "CWE-89" not in anchors
+    assert m2["weights"] == {"CWE-79": 2.0}
+    assert m2["diff"] == diff.to_json()
+    # lineage is root-first and complete
+    assert [m["version"] for m in store.log("v2")] == ["v1", "v2"]
+    assert store.versions() == ["v1", "v2"]
+    assert store.latest() == "v2"
+    # instances feed encode_anchors directly, weights ride in meta
+    instances = store.instances("v2")
+    by_label = {inst["meta"]["label"]: inst for inst in instances}
+    assert by_label["CWE-79"]["meta"]["weight"] == 2.0
+    assert by_label["CWE-502"]["meta"]["weight"] == 1.0
+    assert by_label["CWE-502"]["text1"].startswith("deserialization")
+
+
+def test_store_diff_validation():
+    store_diff = BankDiff.from_json
+    with pytest.raises(BankStoreError):
+        store_diff([{"op": "nuke", "category": "CWE-79"}])
+    with pytest.raises(BankStoreError):
+        store_diff([{"op": "add", "category": "CWE-1", "typo": 1}])
+    anchors = dict(ANCHORS_V1)
+    for bad in (
+        [{"op": "add", "category": "CWE-79", "description": "dup"}],
+        [{"op": "retire", "category": "CWE-404"}],
+        [{"op": "edit", "category": "CWE-404", "description": "x"}],
+        [{"op": "reweight", "category": "CWE-79"}],
+        [{"op": "add", "category": "CWE-1"}],
+    ):
+        with pytest.raises(BankStoreError):
+            store_diff(bad).apply(anchors, {})
+
+
+def test_store_integrity_and_crash_remnants(tmp_path):
+    store = BankStore(tmp_path)
+    store.create(ANCHORS_V1)
+    # tampering with the committed artifact is detected on read
+    anchors_path = tmp_path / "v1" / "anchors.json"
+    anchors_path.write_text(anchors_path.read_text().replace("sql", "SQL"))
+    with pytest.raises(BankIntegrityError):
+        store.anchors("v1")
+    with pytest.raises(BankIntegrityError):
+        store.verify("v1")
+    # a manifest-less dir (crash between anchor write and commit) is
+    # invisible to readers and its id is never reused
+    (tmp_path / "v2").mkdir()
+    assert store.versions() == ["v1"]
+    m3 = store.create(ANCHORS_V1)
+    assert m3["version"] == "v3"
+    # unknown versions and empty banks are refused loudly
+    with pytest.raises(BankStoreError):
+        store.manifest("v9")
+    with pytest.raises(BankStoreError):
+        store.create({})
+    with pytest.raises(BankStoreError):
+        store.derive("v3", BankDiff([]))
+
+
+def test_store_active_pointer_and_promotions(tmp_path):
+    store = BankStore(tmp_path)
+    store.create(ANCHORS_V1)
+    assert store.active() is None
+    with pytest.raises(BankStoreError):
+        store.set_active("v7")  # must point at a committed version
+    record = store.set_active("v1", source="promotion")
+    assert store.active()["version"] == "v1"
+    assert record["source"] == "promotion"
+    store.record_promotion(kind="promotion", candidate="v1")
+    store.record_promotion(kind="demotion", restored="v1")
+    kinds = [r["kind"] for r in store.promotions()]
+    assert kinds == ["promotion", "demotion"]
+
+
+# -- gate (pure logic) ---------------------------------------------------------
+
+GOOD = {"auc": 0.91, "f1": 0.80}
+SHADOW_OK = {"sampled": 500, "flip_rate": 0.004}
+
+
+def _codes(decision):
+    return [r["code"] for r in decision.reasons]
+
+
+def test_gate_approves_within_tolerances():
+    decision = evaluate_gate(
+        GOOD, {"auc": 0.905, "f1": 0.795}, SHADOW_OK,
+        GateThresholds(), candidate="v2", parent="v1",
+    )
+    assert decision.approved and decision.reasons == []
+    assert decision.to_json()["candidate"] == "v2"
+
+
+def test_gate_refusals_are_machine_readable():
+    thresholds = GateThresholds(
+        max_auc_drop=0.01, max_f1_drop=0.01,
+        max_flip_rate=0.02, min_shadow_samples=100,
+    )
+    worse = {"auc": 0.80, "f1": 0.80}
+    decision = evaluate_gate(GOOD, worse, SHADOW_OK, thresholds)
+    assert not decision.approved
+    assert _codes(decision) == [REASON_AUC]
+    assert decision.reasons[0]["observed"] == pytest.approx(0.11)
+    assert decision.reasons[0]["limit"] == 0.01
+    # flip-rate + sample-count gates
+    decision = evaluate_gate(
+        GOOD, GOOD, {"sampled": 10, "flip_rate": 0.5}, thresholds
+    )
+    assert set(_codes(decision)) == {REASON_SHADOW_SAMPLES, REASON_FLIP_RATE}
+    # shadow evidence is mandatory unless explicitly waived
+    decision = evaluate_gate(GOOD, GOOD, None, thresholds)
+    assert _codes(decision) == [REASON_SHADOW_MISSING]
+    waived = GateThresholds(require_shadow=False)
+    assert evaluate_gate(GOOD, GOOD, None, waived).approved
+
+
+def test_promote_refuses_unapproved_decision(tmp_path):
+    store = BankStore(tmp_path)
+    store.create(ANCHORS_V1)
+    decision = evaluate_gate(
+        GOOD, GOOD, None, GateThresholds(), candidate="v1",
+    )
+    with pytest.raises(PromotionRefused) as excinfo:
+        promote(object(), store, decision)
+    refused = excinfo.value.decision
+    assert _codes(refused) == [REASON_SHADOW_MISSING]
+    # the refusal itself is audited, machine-readable
+    audit = store.promotions()
+    assert audit[-1]["kind"] == "promotion_refused"
+    assert audit[-1]["reasons"][0]["code"] == REASON_SHADOW_MISSING
+
+
+# -- drift ---------------------------------------------------------------------
+
+def test_drift_math_and_baseline_roundtrip(tmp_path):
+    assert total_variation({"a": 1.0}, {"a": 1.0}) == 0.0
+    assert total_variation({"a": 1.0}, {"b": 1.0}) == 1.0
+    assert total_variation(
+        {"a": 0.5, "b": 0.5}, {"a": 1.0}
+    ) == pytest.approx(0.5)
+    assert win_shares({}) == {}
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    registry.counter("bank.anchor_wins.CWE-79").inc(3)
+    registry.counter("bank.anchor_wins.CWE-89").inc(1)
+    baseline = pin_baseline(registry, tmp_path / "anchor_baseline.json")
+    assert baseline == {"CWE-79": 0.75, "CWE-89": 0.25}
+    from memvul_tpu.bankops import load_baseline
+
+    assert load_baseline(tmp_path / "anchor_baseline.json") == baseline
+    assert load_baseline(tmp_path / "missing.json") is None
+    # identical distribution → zero drift, published as the gauge
+    assert update_drift_gauge(registry, baseline) == 0.0
+    registry.counter("bank.anchor_wins.CWE-22").inc(96)
+    drift = update_drift_gauge(registry, baseline)
+    assert drift == pytest.approx(0.96)
+    assert registry.snapshot()["gauges"]["bank.anchor_drift"] == drift
+
+
+def test_report_renders_anchor_table_and_shadow_line(tmp_path):
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    registry.counter("bank.anchor_wins.CWE-79").inc(30)
+    registry.counter("bank.anchor_wins.CWE-89").inc(10)
+    registry.histogram("bank.anchor_score.CWE-79").observe(0.9)
+    registry.counter("bank.shadow_sampled").inc(40)
+    registry.counter("bank.shadow_flips").inc(2)
+    pin_baseline(registry, tmp_path / "run" / "anchor_baseline.json")
+    update_drift_gauge(registry, {"CWE-79": 0.5, "CWE-89": 0.5})
+    registry.write_summary()
+    report = render_report(tmp_path / "run")
+    assert "ANCHOR BANK" in report
+    assert "CWE-79" in report and "75.0%" in report
+    assert "drift(gauge)" in report and "drift(vs baseline): 0.000" in report
+    assert "shadow: sampled=40 flips=2 flip_rate=0.0500" in report
+
+
+# -- lint ----------------------------------------------------------------------
+
+def test_bankops_writes_only_through_helpers():
+    from lint_bank_artifact_writes import find_bare_writes
+
+    offenders = find_bare_writes(REPO / "memvul_tpu" / "bankops")
+    assert offenders == [], (
+        "bankops/ must write artifacts via atomic_write_text / JsonlSink "
+        f"(docs/anchor_bank.md): {offenders}"
+    )
+
+
+def test_bank_write_lint_flags_offenders(tmp_path, capsys):
+    from lint_bank_artifact_writes import find_bare_writes, main
+
+    (tmp_path / "bad.py").write_text(
+        "open('x', 'w')\n"
+        "open('y', mode='ab')\n"
+        "from pathlib import Path\n"
+        "Path('z').write_text('t')\n"
+        "open('ok')\n"
+        "open('ok2', 'r')\n"
+    )
+    offenders = find_bare_writes(tmp_path)
+    assert {o.rsplit(":", 1)[1] for o in offenders} == {"1", "2", "4"}
+    assert main([str(tmp_path)]) == 1
+    assert "bad.py:1" in capsys.readouterr().out
+    (tmp_path / "bad.py").write_text("x = open('ok')\n")
+    assert main([str(tmp_path)]) == 0
+    assert main([str(tmp_path / "missing")]) == 2
+
+
+# -- real-model fixtures -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("bankops"), seed=13)
+
+
+@pytest.fixture(scope="module")
+def setup(ws):
+    """One warmed tiny predictor + a v1/v2 bank store: v2 = v1 with one
+    anchor retired and two added (a GEOMETRY-changing diff, so shadow
+    attach exercises the off-path re-warm)."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    predictor = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=8, max_length=48, buckets=[16, 48],
+    )
+    predictor.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    texts = [
+        inst["text1"]
+        for inst in reader.read(ws["paths"]["test"], split="test")
+    ]
+    return predictor, reader, texts
+
+
+@pytest.fixture()
+def store_v2(ws, tmp_path):
+    """A store whose v1 is the workspace's golden bank and whose v2
+    retires one anchor and adds two new ones."""
+    store = BankStore(tmp_path / "banks")
+    anchors = load_anchors(ws["paths"]["anchors"])
+    store.create(anchors, source="build")
+    first = sorted(anchors)[0]
+    store.derive("v1", BankDiff.from_json([
+        {"op": "retire", "category": first},
+        {"op": "add", "category": "CWE-NEW-1",
+         "description": "a brand new weakness class about parsing"},
+        {"op": "add", "category": "CWE-NEW-2",
+         "description": "another new weakness class about memory"},
+    ]))
+    return store
+
+
+def make_service(predictor, tel_dir=None, **overrides):
+    defaults = dict(
+        max_batch=8, max_wait_ms=3.0, max_queue=1000,
+        default_deadline_ms=30000.0,
+    )
+    defaults.update(overrides)
+    return ScoringService(
+        predictor, config=ServiceConfig(**defaults), manifest_dir=tel_dir
+    )
+
+
+def _score_all(service, texts, timeout=60.0):
+    futures = [service.submit(t) for t in texts]
+    return [f.result(timeout) for f in futures]
+
+
+def _wait_counter(registry, name, target, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if registry.counter(name).value >= target:
+            return registry.counter(name).value
+        time.sleep(0.01)
+    return registry.counter(name).value
+
+
+# -- the end-to-end lifecycle (acceptance criteria) ----------------------------
+
+def test_lifecycle_shadow_promote_demote(setup, store_v2, tmp_path):
+    """build v1 → diff v2 → shadow v2 under live load (active bitwise
+    unchanged, traces flat, delta rows exact) → gate refuses then
+    promotes → demote restores the parent."""
+    predictor, reader, texts = setup
+    store = store_v2
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    service = make_service(predictor, tel_dir=tmp_path / "run")
+    texts = texts[:24]
+    try:
+        # -- baseline run, no shadow
+        baseline = _score_all(service, texts)
+        assert all(r["status"] == "ok" for r in baseline)
+        assert all(r["bank_version"] == 1 for r in baseline)
+
+        # -- shadow v2 against live load
+        scorer = ShadowScorer(
+            service,
+            store.instances("v2"),
+            out_dir=tmp_path / "run",
+            config=ShadowConfig(sample_stride=1, max_queue=10_000),
+            candidate_version="v2",
+        )
+        traces_after_attach = predictor.score_trace_count
+        shadowed = _score_all(service, texts)
+        # active responses BITWISE-unchanged with the shadow on
+        for a, b in zip(baseline, shadowed):
+            assert a["predict"] == b["predict"]
+            assert a["anchor"] == b["anchor"]
+        # no mid-serve compile on account of the shadow
+        assert predictor.score_trace_count == traces_after_attach
+        sampled = _wait_counter(registry, "bank.shadow_sampled", len(texts))
+        assert sampled == len(texts)
+        summary = scorer.stop()
+        # one delta row per sampled request, exactly
+        rows, torn = read_jsonl(tmp_path / "run" / SHADOW_DELTAS_NAME)
+        assert torn == 0
+        assert len(rows) == summary["sampled"] == len(texts)
+        assert all(r["candidate_version"] == "v2" for r in rows)
+        assert all(r["active_version"] == 1 for r in rows)
+        for row in rows:
+            assert row["delta"] == pytest.approx(
+                row["shadow_score"] - row["active_score"]
+            )
+
+        # -- gate refuses a candidate without enough shadow evidence,
+        # with a machine-readable reason
+        strict = GateThresholds(min_shadow_samples=10 ** 6)
+        refused = evaluate_gate(
+            {"auc": 0.9, "f1": 0.8}, {"auc": 0.9, "f1": 0.8},
+            summary, strict, candidate="v2", parent="v1",
+        )
+        assert not refused.approved
+        assert refused.reasons[0]["code"] == REASON_SHADOW_SAMPLES
+        assert refused.reasons[0]["observed"] == len(texts)
+        with pytest.raises(PromotionRefused):
+            promote(service, store, refused)
+        assert service.bank_version == 1  # nothing was installed
+
+        # -- and promotes a good one
+        lenient = GateThresholds(
+            max_auc_drop=1.0, max_f1_drop=1.0,
+            max_flip_rate=1.0, min_shadow_samples=1,
+        )
+        approved = evaluate_gate(
+            {"auc": 0.9, "f1": 0.8}, {"auc": 0.9, "f1": 0.8},
+            summary, lenient, candidate="v2", parent="v1",
+        )
+        serving_version = promote(service, store, approved)
+        assert serving_version == 2 and service.bank_version == 2
+        snapshot = service.bank_snapshot()
+        assert snapshot.source == "promotion"
+        assert snapshot.store_version == "v2"
+        assert snapshot.parent_version == 1
+        assert store.active()["version"] == "v2"
+        v2_labels = set(store.anchors("v2"))
+        assert set(service.bank_labels) == v2_labels
+        promoted = _score_all(service, texts[:8])
+        assert all(r["bank_version"] == 2 for r in promoted)
+        manifest = json.loads(
+            (tmp_path / "run" / MANIFEST_NAME).read_text()
+        )
+        assert manifest["source"] == "promotion"
+        assert manifest["store_version"] == "v2"
+        assert manifest["parent_version"] == 1
+
+        # -- demote restores the parent
+        result = demote(service, store)
+        assert result["version"] == "v1"
+        assert service.bank_version == result["serving_version"] == 3
+        assert set(service.bank_labels) == set(store.anchors("v1"))
+        assert service.bank_snapshot().source == "demotion"
+        assert store.active()["version"] == "v1"
+        kinds = [r["kind"] for r in store.promotions()]
+        assert kinds == ["promotion_refused", "promotion", "demotion"]
+
+        # -- per-anchor win/drift table renders
+        registry.write_summary()
+        report = render_report(tmp_path / "run")
+        assert "ANCHOR BANK" in report
+        assert "shadow: sampled=" in report
+    finally:
+        service.drain()
+
+
+def test_shadow_fault_never_touches_active_path(setup, tmp_path):
+    """Chaos: the ``bank.shadow`` fault point crashes the shadow worker
+    — errors land in ``bank.shadow_errors``, every client still gets an
+    ``ok`` response, and the serve counter invariant holds exactly."""
+    predictor, reader, texts = setup
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    service = make_service(predictor)
+    texts = texts[:12]
+    faults.configure("bank.shadow=raise:RuntimeError:shadow boom")
+    try:
+        scorer = ShadowScorer(
+            service, predictor_bank_instances(reader, predictor),
+            out_dir=tmp_path / "run",
+            config=ShadowConfig(sample_stride=1, max_queue=10_000),
+        )
+        responses = _score_all(service, texts)
+        assert all(r["status"] == "ok" for r in responses)
+        # wait for the worker to account every tapped sample (scored or
+        # errored) before detaching — the tap fires after resolution
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            counters = registry.snapshot()["counters"]
+            done = (
+                counters.get("bank.shadow_sampled", 0)
+                + counters.get("bank.shadow_errors", 0)
+            )
+            if done >= len(texts):
+                break
+            time.sleep(0.01)
+        errors = registry.counter("bank.shadow_errors").value
+        assert errors >= 1
+        summary = scorer.stop()
+        assert summary["errors"] >= 1
+        # the active path never counted an error, and the invariant sums
+        counters = registry.snapshot()["counters"]
+        assert counters.get("serve.errors", 0) == 0
+        assert counters["serve.served"] == len(texts)
+        assert (
+            counters["serve.served"]
+            + counters.get("serve.shed", 0)
+            + counters.get("serve.errors", 0)
+            == counters["serve.requests"]
+        )
+        # shadowed rows = sampled - errored; every written row is intact
+        rows, torn = read_jsonl(tmp_path / "run" / SHADOW_DELTAS_NAME)
+        assert torn == 0
+        assert len(rows) == summary["sampled"] == len(texts) - errors
+    finally:
+        service.drain()
+
+
+def predictor_bank_instances(reader, predictor):
+    """The predictor's own anchors as instances (an identity candidate)."""
+    return [
+        {"text1": "anchor text for " + label, "label": "same",
+         "meta": {"type": "golden", "label": label}}
+        for label in predictor.anchor_labels
+    ]
+
+
+def test_offline_replay_matches_recorded_run(setup, store_v2, ws, tmp_path):
+    """Offline shadow: replaying a predict_file output against the SAME
+    bank yields zero delta and zero flips, one row per recorded report."""
+    predictor, reader, texts = setup
+    store = store_v2
+    out = tmp_path / "replay"
+    out.mkdir()
+    results = out / "memory_result.json"
+    metrics = predictor.predict_file(
+        reader, ws["paths"]["test"], results, split="test"
+    )
+    summary = replay_results(
+        predictor,
+        store.instances("v1"),
+        reader,
+        corpus_path=ws["paths"]["test"],
+        results_path=results,
+        out_dir=out,
+        split="test",
+        candidate_version="v1",
+    )
+    assert summary["sampled"] == int(metrics["num_samples"])
+    assert summary["flips"] == 0
+    assert summary["mean_abs_delta"] == pytest.approx(0.0, abs=1e-6)
+    rows, torn = read_jsonl(out / SHADOW_DELTAS_NAME)
+    assert torn == 0 and len(rows) == summary["sampled"]
+    assert all(r["shadow_anchor"] == r["active_anchor"] for r in rows)
+
+
+def test_golden_metrics_smoke(setup, store_v2, ws):
+    predictor, reader, _texts = setup
+    metrics = golden_metrics(
+        predictor,
+        store_v2.instances("v1"),
+        list(reader.read(ws["paths"]["test"], split="test"))[:16],
+    )
+    for key in ("auc", "f1", "precision", "recall"):
+        assert key in metrics
+    assert metrics["n_eval"] == 16
+
+
+# -- offline attribution satellites --------------------------------------------
+
+def test_score_instances_anchor_attribution_flag(setup, ws):
+    predictor, reader, _texts = setup
+    instances = list(reader.read(ws["paths"]["test"], split="test"))[:8]
+    # default: metas untouched
+    for probs, metas in predictor.score_instances(iter(instances)):
+        assert all("_anchor" not in m for m in metas)
+    for probs, metas in predictor.score_instances(
+        iter(instances), with_anchors=True
+    ):
+        for row, meta in zip(probs, metas):
+            assert meta["_anchor_index"] == int(np.argmax(row))
+            assert (
+                meta["_anchor"]
+                == predictor.anchor_labels[meta["_anchor_index"]]
+            )
+
+
+def test_predict_file_attribute_anchors_flag(setup, ws, tmp_path):
+    predictor, reader, _texts = setup
+    default_out = tmp_path / "default.json"
+    predictor.predict_file(
+        reader, ws["paths"]["test"], default_out, split="test"
+    )
+    records = [
+        rec
+        for line in default_out.read_text().splitlines()
+        for rec in json.loads(line)
+    ]
+    assert records and all("anchor" not in r for r in records)
+    attributed_out = tmp_path / "attributed.json"
+    predictor.predict_file(
+        reader, ws["paths"]["test"], attributed_out, split="test",
+        attribute_anchors=True,
+    )
+    attributed = [
+        rec
+        for line in attributed_out.read_text().splitlines()
+        for rec in json.loads(line)
+    ]
+    assert len(attributed) == len(records)
+    for rec in attributed:
+        assert rec["anchor"] == max(rec["predict"], key=rec["predict"].get)
+        assert rec["anchor_index"] == predictor.anchor_labels.index(
+            rec["anchor"]
+        )
+        # the probability payload itself is unchanged by the flag
+    assert [r["predict"] for r in attributed] == [
+        r["predict"] for r in records
+    ]
+
+
+def test_predict_single_returns_attribution(setup):
+    predictor, _reader, texts = setup
+    traces = predictor.score_trace_count
+    result = predictor.predict_single(texts[0])
+    assert predictor.score_trace_count == traces  # warmed shape, no trace
+    assert set(result) == {"predict", "score", "anchor", "anchor_index"}
+    assert result["anchor"] == max(
+        result["predict"], key=result["predict"].get
+    )
+    assert result["score"] == result["predict"][result["anchor"]]
+    assert (
+        predictor.anchor_labels[result["anchor_index"]] == result["anchor"]
+    )
+
+
+# -- serving provenance satellite ----------------------------------------------
+
+def test_swap_bank_manifest_and_health_record_provenance(setup, tmp_path):
+    predictor, reader, _texts = setup
+    telemetry.configure(run_dir=tmp_path / "run")
+    service = make_service(predictor, tel_dir=tmp_path / "run")
+    try:
+        manifest = json.loads((tmp_path / "run" / MANIFEST_NAME).read_text())
+        assert manifest["source"] == "startup"
+        assert manifest["parent_version"] is None
+        service.swap_bank(
+            predictor_bank_instances(reader, predictor), source="manual"
+        )
+        manifest = json.loads((tmp_path / "run" / MANIFEST_NAME).read_text())
+        assert manifest["version"] == 2
+        assert manifest["parent_version"] == 1
+        assert manifest["source"] == "manual"
+        assert manifest["store_version"] is None
+        health = service.health_summary()
+        assert health["bank"] == {
+            "version": 2, "source": "manual",
+            "parent_version": 1, "store_version": None,
+        }
+    finally:
+        service.drain()
+
+
+# -- fleet promotion via rolling_swap (fake predictors, fast) ------------------
+
+class _FakeEncoder:
+    pad_id = 0
+
+    def __init__(self, max_length=8):
+        self.max_length = max_length
+
+    def encode_many(self, texts):
+        return [[1] * min(max(len(t), 1), self.max_length) for t in texts]
+
+
+class _FakePredictor:
+    def __init__(self, n_anchors=3, rows=4, length=8):
+        self.encoder = _FakeEncoder(length)
+        self.mesh = None
+        self.params = None
+        self.n_anchors = n_anchors
+        self.anchor_labels = [f"A{i}" for i in range(n_anchors)]
+        self.anchor_bank = np.zeros((n_anchors, 2), np.float32)
+        self.score_trace_count = 0
+        self._shapes = [(rows, length)]
+
+    def stream_shapes(self):
+        return list(self._shapes)
+
+    def encode_bank(self, instances):
+        instances = list(instances)
+        labels = [inst["meta"]["label"] for inst in instances]
+        return np.zeros((len(labels), 2), np.float32), labels, len(labels)
+
+    def warmup_bank_shapes(self, bank):
+        return len(self._shapes)
+
+    def _score_fn(self, params, sample, bank):
+        rows = sample["input_ids"].shape[0]
+        return np.tile(
+            np.linspace(0.1, 0.9, bank.shape[0], dtype=np.float32), (rows, 1)
+        )
+
+
+def _fake_fleet(n=2):
+    def make_factory(i):
+        def factory(registry):
+            return ScoringService(
+                _FakePredictor(),
+                config=ServiceConfig(
+                    max_batch=4, max_wait_ms=1.0, max_queue=1000,
+                    default_deadline_ms=30000.0,
+                ),
+                registry=registry,
+            )
+        return factory
+
+    replicas = [
+        Replica(i, make_factory(i), telemetry_enabled=True) for i in range(n)
+    ]
+    router = ReplicaRouter(
+        replicas, config=RouterConfig(monitor_interval_s=0.05)
+    )
+    return router, replicas
+
+
+def test_fleet_promotion_rolls_and_demotes(tmp_path):
+    """promote() on a router goes through rolling_swap: the fleet
+    advances one version, every response under load carries exactly one
+    version, provenance lands in every replica's health row, and
+    demote() rolls the parent back out."""
+    store = BankStore(tmp_path / "banks")
+    store.create({"A0": "zero", "A1": "one", "A2": "two"})
+    store.derive("v1", BankDiff.from_json([
+        {"op": "add", "category": "A3", "description": "three"},
+    ]))
+    router, replicas = _fake_fleet(2)
+    try:
+        stop = threading.Event()
+        versions_seen = set()
+        failures = []
+
+        def client():
+            while not stop.is_set():
+                try:
+                    response = router.submit("report text").result(10)
+                except Exception as e:  # pragma: no cover - fail the test
+                    failures.append(repr(e))
+                    return
+                if response["status"] == "ok":
+                    versions_seen.add(response["bank_version"])
+
+        thread = threading.Thread(target=client)
+        thread.start()
+        decision = PromotionDecision(
+            approved=True, candidate="v2", parent="v1",
+            reasons=[], metrics={},
+        )
+        serving_version = promote(router, store, decision)
+        stop.set()
+        thread.join(10)
+        assert not failures, failures
+        assert serving_version == 2 and router.bank_version == 2
+        # every response carried exactly one of the two rollout versions
+        assert versions_seen <= {1, 2}
+        for replica in replicas:
+            row = replica.summary()
+            assert row["bank_version"] == 2
+            assert row["bank_source"] == "promotion"
+            assert row["bank_store_version"] == "v2"
+        assert store.active()["version"] == "v2"
+        # demote: the parent rolls back out fleet-wide
+        result = demote(router, store)
+        assert result["version"] == "v1"
+        assert router.bank_version == result["serving_version"] == 3
+        for replica in replicas:
+            row = replica.summary()
+            assert row["bank_source"] == "demotion"
+            assert row["bank_store_version"] == "v1"
+            assert set(replica.service.bank_labels) == {"A0", "A1", "A2"}
+        assert store.active()["version"] == "v1"
+    finally:
+        router.drain()
+
+
+def test_router_shadow_tap_fans_out_and_survives_restart():
+    """The router installs one tap on every replica, and a replica
+    restart re-attaches it (a death must not silently end a shadow
+    evaluation)."""
+    router, replicas = _fake_fleet(2)
+    try:
+        seen = []
+        router.set_shadow_tap(lambda texts, probs, bank: seen.append(1))
+        for replica in replicas:
+            assert replica.service._shadow_tap is not None
+        replicas[0].restart()
+        assert replicas[0].service._shadow_tap is not None
+        router.clear_shadow_tap()
+        for replica in replicas:
+            assert replica.service._shadow_tap is None
+    finally:
+        router.drain()
+
+
+# -- CLI -----------------------------------------------------------------------
+
+def test_bank_cli_build_diff_log_roundtrip(tmp_path, capsys):
+    from memvul_tpu.__main__ import main
+
+    anchors_path = tmp_path / "anchors.json"
+    anchors_path.write_text(json.dumps(ANCHORS_V1))
+    store_dir = tmp_path / "banks"
+    assert main([
+        "bank", "build", "--store", str(store_dir),
+        "--anchors", str(anchors_path), "--note", "seed",
+    ]) == 0
+    built = json.loads(capsys.readouterr().out)
+    assert built["version"] == "v1" and built["n_anchors"] == 3
+    ops = [
+        {"op": "add", "category": "CWE-502", "description": "deser"},
+    ]
+    assert main([
+        "bank", "diff", "--store", str(store_dir),
+        "--ops", json.dumps(ops),
+        "--retire", "CWE-89", "--reweight", "CWE-79=2.5",
+    ]) == 0
+    derived = json.loads(capsys.readouterr().out)
+    assert derived["version"] == "v2" and derived["parent"] == "v1"
+    assert derived["weights"] == {"CWE-79": 2.5}
+    assert main(["bank", "log", "--store", str(store_dir)]) == 0
+    log = json.loads(capsys.readouterr().out)
+    assert log["versions"] == ["v1", "v2"]
+    assert [m["version"] for m in log["lineage"]] == ["v1", "v2"]
+    # a conflicting diff exits 2 with a usage message, not a traceback
+    assert main([
+        "bank", "diff", "--store", str(store_dir), "--retire", "CWE-404",
+    ]) == 2

@@ -701,7 +701,7 @@ def test_cli_help_names_every_registered_subcommand(capsys):
     # the full current command surface; growing it here is deliberate
     assert {
         "train", "evaluate", "serve", "pretrain", "baseline", "build-data",
-        "analyze", "bench", "telemetry-report", "doctor", "parity",
+        "analyze", "bench", "bank", "telemetry-report", "doctor", "parity",
         "selfcheck",
     } <= names
     # every subcommand carries a non-empty one-line help
@@ -724,3 +724,32 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         for flag in action.option_strings
     }
     assert {"--replicas", "--out-dir", "--overrides", "--port"} <= serve_flags
+
+
+def test_cli_bank_help_names_every_lifecycle_subcommand(capsys):
+    """The ``bank`` group's --help must name the full lifecycle surface
+    (docs/anchor_bank.md): build → diff → log → shadow → promote."""
+    import argparse
+
+    from memvul_tpu.__main__ import build_parser
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions
+        if isinstance(a, argparse._SubParsersAction)
+    )
+    bank_sub = next(
+        a for a in sub.choices["bank"]._actions
+        if isinstance(a, argparse._SubParsersAction)
+    )
+    expected = {"build", "diff", "log", "shadow", "promote"}
+    assert expected <= set(bank_sub.choices)
+    helps = {ca.dest: ca.help for ca in bank_sub._choices_actions}
+    for name in expected:
+        assert helps.get(name), f"bank subcommand {name!r} has no help text"
+    with pytest.raises(SystemExit) as exc:
+        main(["bank", "--help"])
+    assert exc.value.code == 0
+    out = capsys.readouterr().out
+    for name in expected:
+        assert name in out

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Lint: ``bankops/`` may write artifacts only through the committed
+helpers — ``resilience.io.atomic_write_text`` (whole-document commits)
+or the telemetry ``JsonlSink`` (append-only trails).
+
+A bank version is an *immutable, digest-verified* artifact
+(docs/anchor_bank.md): a bare ``open(..., "w")`` or
+``Path.write_text`` in the lifecycle subsystem is a torn-write hazard
+— a kill mid-write would leave half an anchor set or half a manifest
+where a promotion gate expects a committed version.  This AST check
+flags, anywhere under the target dir (default
+``memvul_tpu/bankops/``):
+
+* ``open(...)`` calls whose mode (2nd positional or ``mode=`` keyword)
+  contains any of ``w``/``a``/``x``/``+`` — read-only opens are fine;
+* ``.write_text(...)`` / ``.write_bytes(...)`` attribute calls (the
+  ``Path`` direct-write API).
+
+Usage: ``python tools/lint_bank_artifact_writes.py [dir]`` — exits 1
+listing offenders, 0 when clean, 2 on a bad argument.  Invoked as a
+tier-1 test from ``tests/test_bankops.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+WRITE_MODE_CHARS = set("wax+")
+FORBIDDEN_ATTRS = {"write_text", "write_bytes"}
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True when this is an ``open(...)`` call with a writing mode."""
+    func = node.func
+    name = func.id if isinstance(func, ast.Name) else (
+        func.attr if isinstance(func, ast.Attribute) else ""
+    )
+    if name != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(set(mode.value) & WRITE_MODE_CHARS)
+    return True  # dynamic mode: flag it — artifact writes must be static
+
+
+def find_bare_writes(root: Path) -> List[str]:
+    """``path:line`` offender list for every direct artifact write."""
+    offenders: List[str] = []
+    for path in sorted(root.rglob("*.py")):
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _open_write_mode(node):
+                offenders.append(f"{path}:{node.lineno}")
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in FORBIDDEN_ATTRS
+            ):
+                offenders.append(f"{path}:{node.lineno}")
+    return offenders
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = Path(argv[0]) if argv else (
+        Path(__file__).resolve().parent.parent / "memvul_tpu" / "bankops"
+    )
+    if not root.is_dir():
+        print(f"not a directory: {root}", file=sys.stderr)
+        return 2
+    offenders = find_bare_writes(root)
+    for offender in offenders:
+        print(offender)
+    return 1 if offenders else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
