@@ -42,7 +42,6 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from ..data.batching import _pad_block
 from ..resilience import faults
 from ..telemetry import get_registry
 from ..telemetry.sinks import JsonlSink
@@ -70,46 +69,23 @@ def score_texts(
     n_anchors: int,
 ) -> np.ndarray:
     """Score ``texts`` against an *explicit* bank through the
-    predictor's warmed score program — the same bucket routing and
-    ``_pad_block`` padding the serving micro-batcher uses, so a shadow
-    score of a request is bitwise what the candidate bank *would have
-    served* for it.  Returns ``[len(texts), n_anchors]`` probabilities.
+    predictor's warmed serving impl — bucket routing + ``_pad_block``
+    when the active path is bucketed, token-budget packing through the
+    single warmed ragged program when it is ragged
+    (:meth:`SiamesePredictor.score_texts` owns the routing) — so a
+    shadow score of a request is computed exactly the way the candidate
+    bank *would have served* it, whichever impl is live.  Shadow deltas
+    are therefore impl-invariant by construction (pinned in
+    tests/test_ragged_serving.py).  Returns ``[len(texts), n_anchors]``
+    probabilities.
 
-    Dispatches only the predictor's warmed ``stream_shapes``; callers
-    warm a new-geometry bank via ``warmup_bank_shapes`` first (the
-    shadow/gate attach paths do), keeping ``score_trace_count`` flat.
+    Dispatches only the predictor's warmed shapes; callers warm a
+    new-geometry bank via ``warmup_bank_shapes`` first (the shadow/gate
+    attach paths do), keeping ``score_trace_count`` flat.
     """
     if not texts:
         return np.zeros((0, n_anchors), np.float32)
-    from ..parallel.mesh import shard_batch
-
-    rows_by_length = {
-        length: rows for rows, length in predictor.stream_shapes()
-    }
-    lengths = sorted(rows_by_length)
-    seqs = predictor.encoder.encode_many(list(texts))
-    out = np.zeros((len(texts), n_anchors), np.float32)
-    groups: Dict[int, List[int]] = {}
-    for i, seq in enumerate(seqs):
-        n_tokens = len(seq)
-        length = next((b for b in lengths if b >= n_tokens), lengths[-1])
-        groups.setdefault(length, []).append(i)
-    for length in sorted(groups):
-        rows = rows_by_length[length]
-        indices = groups[length]
-        for start in range(0, len(indices), rows):
-            chunk = indices[start : start + rows]
-            sample = _pad_block(
-                [seqs[i] for i in chunk], rows,
-                predictor.encoder.pad_id, length,
-            )
-            if predictor.mesh is not None:
-                sample = shard_batch(sample, predictor.mesh)
-            dev = predictor._score_fn(predictor.params, sample, bank_array)
-            probs = np.asarray(dev)[: len(chunk), :n_anchors]
-            for row, i in zip(probs, chunk):
-                out[i] = row
-    return out
+    return predictor.score_texts(texts, bank_array, n_anchors)
 
 
 def _delta_row(

@@ -713,92 +713,160 @@ def _run_serve_micro() -> None:
         max_queue=max(256, 2 * n_clients * max_batch),
         default_deadline_ms=0.0,  # measure latency, don't shed it
     )
+    # ragged serve A/B (docs/ragged_serving.md): BENCH_SERVE_IMPL picks
+    # the dispatch path — "bucketed" (default), "ragged", or "ab", which
+    # drives BOTH paths with the identical seeded schedule so the record
+    # quantifies the padding win (real_token_utilization) directly
+    impl_mode = os.environ.get("BENCH_SERVE_IMPL", "bucketed")
+    if impl_mode not in ("bucketed", "ragged", "ab"):
+        raise SystemExit(
+            f"BENCH_SERVE_IMPL must be bucketed|ragged|ab, got {impl_mode!r}"
+        )
+    token_budget = int(
+        os.environ.get("BENCH_SERVE_TOKEN_BUDGET", str(4 * seq_len))
+    )
 
-    def build_service(registry=None) -> ScoringService:
+    def build_service(registry=None, impl: str = "bucketed") -> ScoringService:
+        kwargs = (
+            dict(
+                score_impl="ragged", token_budget=token_budget,
+                max_rows_per_pack=max_batch,
+            )
+            if impl == "ragged" else {}
+        )
         predictor = SiamesePredictor(
             model, params, ws["tokenizer"],
             batch_size=max_batch, max_length=seq_len, buckets=buckets,
+            **kwargs,
         )
         predictor.encode_anchors(anchor_instances)
         return ScoringService(predictor, config=service_config, registry=registry)
 
     if n_replicas > 1:
+        router_impl = "bucketed" if impl_mode == "ab" else impl_mode
         _run_serve_router_micro(
-            watchdog, build_service, texts,
+            watchdog,
+            lambda registry=None: build_service(registry, impl=router_impl),
+            texts,
             n_requests=n_requests, n_clients=n_clients,
             n_replicas=n_replicas, seq_len=seq_len, buckets=buckets,
             max_batch=max_batch, max_wait_ms=max_wait_ms,
         )
         return
 
-    with watchdog.phase("anchor_encode"):
-        service = build_service()
-    client = InprocessClient(service)
-    work: "_queue.SimpleQueue" = _queue.SimpleQueue()
-    for text in texts:
-        work.put(text)
-    latencies: list = []
-    lat_lock = threading.Lock()
-    errors = [0]
+    def _drive_leg(impl: str) -> dict:
+        """One closed-loop run: build the service for ``impl``, push the
+        SAME seeded text schedule through it, return the leg record
+        (rps, latency percentiles, and the padding ledger read from the
+        leg's own registry)."""
+        from memvul_tpu.telemetry.registry import TelemetryRegistry
 
-    def _client_loop():
-        own: list = []
-        while True:
-            try:
-                text = work.get_nowait()
-            except _queue.Empty:
-                break
-            t0 = time.perf_counter()
-            resp = client.score(text, deadline_ms=0)
-            own.append(time.perf_counter() - t0)
-            if resp["status"] != "ok":
-                errors[0] += 1
-        with lat_lock:
-            latencies.extend(own)
+        registry = TelemetryRegistry(enabled=True)
+        with watchdog.phase(f"anchor_encode_{impl}"):
+            service = build_service(registry=registry, impl=impl)
+        client = InprocessClient(service)
+        work: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        for text in texts:
+            work.put(text)
+        latencies: list = []
+        lat_lock = threading.Lock()
+        errors = [0]
 
-    # warmup trickle so thread pools/allocator ramp isn't billed to the load
-    with watchdog.phase("serve_warmup"):
-        client.score(texts[0], deadline_ms=0)
-    with watchdog.phase("serve_load"):
-        threads = [
-            threading.Thread(target=_client_loop, daemon=True)
-            for _ in range(n_clients)
-        ]
-        start = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        elapsed = time.perf_counter() - start
-    service.drain()
+        def _client_loop():
+            own: list = []
+            while True:
+                try:
+                    text = work.get_nowait()
+                except _queue.Empty:
+                    break
+                t0 = time.perf_counter()
+                resp = client.score(text, deadline_ms=0)
+                own.append(time.perf_counter() - t0)
+                if resp["status"] != "ok":
+                    errors[0] += 1
+            with lat_lock:
+                latencies.extend(own)
 
-    lat_ms = np.sort(np.asarray(latencies)) * 1e3
-    pct = lambda q: round(float(np.percentile(lat_ms, q)), 3) if len(lat_ms) else None
-    print(
-        json.dumps(
-            {
-                "metric": "serve_microbench",
-                "value": round(n_requests / elapsed, 1),
-                "unit": "requests/sec",
-                "vs_baseline": 0.0,  # no serving baseline exists (BASELINE.md)
-                "latency_ms": {
-                    "p50": pct(50), "p95": pct(95), "p99": pct(99),
-                    "max": round(float(lat_ms[-1]), 3) if len(lat_ms) else None,
-                    "mean": round(float(lat_ms.mean()), 3) if len(lat_ms) else None,
-                },
-                "errors": errors[0],
-                "config": {
-                    "model": os.environ.get("BENCH_MODEL", "base"),
-                    "seq_len": seq_len,
-                    "buckets": list(buckets),
-                    "requests": n_requests,
-                    "clients": n_clients,
-                    "max_batch": max_batch,
-                    "max_wait_ms": max_wait_ms,
-                },
-            }
+        # warmup trickle so pools/allocator ramp isn't billed to the load
+        with watchdog.phase(f"serve_warmup_{impl}"):
+            client.score(texts[0], deadline_ms=0)
+        with watchdog.phase(f"serve_load_{impl}"):
+            threads = [
+                threading.Thread(target=_client_loop, daemon=True)
+                for _ in range(n_clients)
+            ]
+            start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - start
+        service.drain()
+        counters = registry.snapshot()["counters"]
+        real = int(counters.get("serve.tokens_real", 0))
+        padded = int(counters.get("serve.tokens_padded", 0))
+        lat_ms = np.sort(np.asarray(latencies)) * 1e3
+        pct = (
+            lambda q: round(float(np.percentile(lat_ms, q)), 3)
+            if len(lat_ms) else None
         )
+        return {
+            "impl": impl,
+            "requests_per_sec": round(n_requests / elapsed, 1),
+            "latency_ms": {
+                "p50": pct(50), "p95": pct(95), "p99": pct(99),
+                "max": round(float(lat_ms[-1]), 3) if len(lat_ms) else None,
+                "mean": round(float(lat_ms.mean()), 3) if len(lat_ms) else None,
+            },
+            "errors": errors[0],
+            # the padding ledger: tokens requests carried vs token slots
+            # the dispatched shapes paid for — the FLOP-waste fraction
+            # the ragged path exists to reclaim
+            "real_tokens": real,
+            "padded_tokens": padded,
+            "real_token_utilization": (
+                round(real / padded, 4) if padded else None
+            ),
+        }
+
+    legs = (
+        ["bucketed", "ragged"] if impl_mode == "ab" else [impl_mode]
     )
+    records = [_drive_leg(impl) for impl in legs]
+    primary = records[-1]  # ragged in ab mode; the single leg otherwise
+    record = {
+        "metric": "serve_microbench",
+        "value": primary["requests_per_sec"],
+        "unit": "requests/sec",
+        "vs_baseline": 0.0,  # no serving baseline exists (BASELINE.md)
+        "impl": primary["impl"],
+        "latency_ms": primary["latency_ms"],
+        "errors": primary["errors"],
+        "real_tokens": primary["real_tokens"],
+        "padded_tokens": primary["padded_tokens"],
+        "real_token_utilization": primary["real_token_utilization"],
+        "config": {
+            "model": os.environ.get("BENCH_MODEL", "base"),
+            "seq_len": seq_len,
+            "buckets": list(buckets),
+            "requests": n_requests,
+            "clients": n_clients,
+            "max_batch": max_batch,
+            "max_wait_ms": max_wait_ms,
+            "impl_mode": impl_mode,
+            "token_budget": token_budget,
+        },
+    }
+    if impl_mode == "ab":
+        by_impl = {leg["impl"]: leg for leg in records}
+        record["ab"] = by_impl
+        bucketed_util = by_impl["bucketed"]["real_token_utilization"]
+        ragged_util = by_impl["ragged"]["real_token_utilization"]
+        if bucketed_util and ragged_util:
+            record["utilization_gain"] = round(
+                ragged_util / bucketed_util, 3
+            )
+    print(json.dumps(record))
 
 
 def _run_serve_router_micro(

@@ -392,6 +392,23 @@ def serve_from_archive(
         )
     if buckets is not None:
         buckets = validate_buckets([int(b) for b in buckets], max_length)
+    # ragged serve path (docs/ragged_serving.md): one packed program
+    # replaces the bucket grid; sizing defaults derive from the serve
+    # envelope (budget covers max_batch typical-length requests only if
+    # configured — the default 4×max_length favors a small warm program)
+    score_impl = str(serve_cfg["score_impl"])
+    if score_impl not in ("bucketed", "ragged"):
+        raise ValueError(
+            f"serving.score_impl must be 'bucketed' or 'ragged', got "
+            f"{score_impl!r}"
+        )
+    token_budget = serve_cfg["token_budget"]
+    token_budget = None if token_budget is None else int(token_budget)
+    max_rows_per_pack = serve_cfg["max_rows_per_pack"]
+    max_rows_per_pack = (
+        int(serve_cfg["max_batch"]) if max_rows_per_pack is None
+        else int(max_rows_per_pack)
+    )
     reader = build_reader(arch.config.get("dataset_reader"))
     golden = golden_file or (
         arch.config.get("dataset_reader") or {}
@@ -450,6 +467,9 @@ def serve_from_archive(
             max_length=max_length,
             buckets=buckets,
             aot_warmup=True,  # the whole point: no mid-serve compiles
+            score_impl=score_impl,
+            token_budget=token_budget,
+            max_rows_per_pack=max_rows_per_pack,
         )
         predictor.encode_anchors(anchors)
         return _with_drift_monitor(ScoringService(
@@ -482,6 +502,9 @@ def serve_from_archive(
                 max_length=max_length,
                 buckets=buckets,
                 aot_warmup=True,
+                score_impl=score_impl,
+                token_budget=token_budget,
+                max_rows_per_pack=max_rows_per_pack,
             )
             predictor.encode_anchors(anchors)
             return ScoringService(

@@ -8,7 +8,11 @@ streams through :func:`bucketed_batches_from_instances` (the
 corpus-scoring path), Siamese pair streams through
 :func:`bucketed_pair_batches_from_instances` (the training path:
 per-side bucket grid + in-batch side-2 dedup,
-docs/training_throughput.md).  It also memoizes text→ids (CVE
+docs/training_throughput.md).  The ragged serve path replaces bucket
+padding entirely: :func:`pack_token_budget` packs variable-length
+requests into fixed ``[1, token_budget]`` flat batches and
+:func:`collate_ragged` emits the segment/position/row tables one warm
+program serves (docs/ragged_serving.md).  It also memoizes text→ids (CVE
 descriptions and anchors repeat heavily in the pair stream; hit/miss
 telemetry makes the memo auditable) and can prefetch batches on a
 background thread — optionally committing them to device there too (the
@@ -41,6 +45,7 @@ class CachedEncoder:
         self._max_length = max_length
         self._cache: Dict[str, List[int]] = {}
         self._cache_size = cache_size
+        self._beyond: Dict[Tuple[int, str], bool] = {}  # encodes_beyond memo
 
     @property
     def pad_id(self) -> int:
@@ -62,6 +67,22 @@ class CachedEncoder:
         else:
             get_registry().counter("data.encode_cache_hits").inc()
         return ids
+
+    def encodes_beyond(self, text: str, cap: int) -> bool:
+        """True when ``text`` tokenizes to MORE than ``cap`` tokens — the
+        serving truncation probe (``serve.truncated``).  The capped
+        ``encode`` output is indistinguishable between "exactly cap
+        tokens" and "clamped", so this re-encodes at ``cap + 1``; callers
+        only probe sequences already sitting at the cap, and the verdict
+        is memoized, which keeps the extra tokenizer call off the
+        steady-state path."""
+        key = (cap, text)
+        hit = self._beyond.get(key)
+        if hit is None:
+            hit = len(self._tokenizer.encode(text, max_length=cap + 1)) > cap
+            if len(self._beyond) < self._cache_size:
+                self._beyond[key] = hit
+        return hit
 
     def encode_many(self, texts: Sequence[str]) -> List[List[int]]:
         """Batch lookup: cache misses go through the tokenizer's parallel
@@ -467,6 +488,110 @@ def _collate_pair_cell(
     else:
         batch["sample2"] = _pad_block(seqs2, batch_size, encoder.pad_id, length2)
     return batch
+
+
+def pack_token_budget(
+    lengths: Sequence[int],
+    token_budget: int,
+    max_rows: int,
+) -> List[List[int]]:
+    """Pack row lengths into fixed-budget flat batches (the ragged serve
+    path, docs/ragged_serving.md).
+
+    Greedy, strictly in input order: row ``i`` joins the open pack
+    unless its tokens would overflow ``token_budget`` or the pack
+    already holds ``max_rows`` rows, in which case the open pack is
+    sealed and a new one starts.  The final partial pack is flushed as
+    the tail.  Emission is therefore a PURE function of the input order
+    — the same multiset of lengths in the same order always produces
+    the same packs, and the packs covering a prefix of the input never
+    depend on what follows it (the property the hypothesis suite pins).
+
+    Returns a list of index lists; every input index appears in exactly
+    one pack.  Lengths are clamped to ``token_budget`` defensively —
+    callers size the budget to cover ``max_length``, which the
+    tokenizer already caps sequences at.
+    """
+    if token_budget < 1:
+        raise ValueError(f"token_budget must be >= 1, got {token_budget}")
+    if max_rows < 1:
+        raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+    packs: List[List[int]] = []
+    open_pack: List[int] = []
+    used = 0
+    for i, length in enumerate(lengths):
+        n = max(1, min(int(length), token_budget))
+        if open_pack and (used + n > token_budget or len(open_pack) == max_rows):
+            packs.append(open_pack)
+            open_pack, used = [], 0
+        open_pack.append(i)
+        used += n
+    if open_pack:
+        packs.append(open_pack)
+    return packs
+
+
+def collate_ragged(
+    seqs: Sequence[List[int]],
+    token_budget: int,
+    max_rows: int,
+    pad_id: int,
+) -> Dict[str, np.ndarray]:
+    """One pack of sequences → the fixed-shape flat sample the ragged
+    score program consumes (docs/ragged_serving.md).
+
+    Layout: the sequences are laid end-to-end in a single ``[1,
+    token_budget]`` token row; the row table says where each request
+    lives —
+
+    * ``input_ids``/``attention_mask`` [1, budget]: the flat tokens,
+      ``pad_id``/0 past the packed tail;
+    * ``segment_ids`` [1, budget] int32: row ``i``'s positions carry
+      ``i + 1``; dead positions carry 0 (attention masks on equality
+      with non-zero, ops/pallas/ragged_attention.py);
+    * ``position_ids`` [1, budget] int32: restart at 0 on every row
+      boundary, so each request sees exactly the position embeddings
+      the padded path gives it;
+    * ``row_starts`` [max_rows] int32: offset of each row's first
+      (CLS) token — the segment-aware pooling gather; dead rows point
+      at 0 and are sliced off host-side by the real row count.
+
+    Every array has a shape that depends only on ``(token_budget,
+    max_rows)`` — ONE compiled program serves any length mix — and the
+    populated prefix depends only on the sequences themselves, so
+    growing ``max_rows`` (more trailing dead rows) changes nothing a
+    real row's score can see (pinned by the hypothesis suite).
+    """
+    if len(seqs) > max_rows:
+        raise ValueError(f"{len(seqs)} rows exceed max_rows={max_rows}")
+    ids = np.full((1, token_budget), pad_id, dtype=np.int32)
+    mask = np.zeros((1, token_budget), dtype=np.int32)
+    segments = np.zeros((1, token_budget), dtype=np.int32)
+    positions = np.zeros((1, token_budget), dtype=np.int32)
+    row_starts = np.zeros(max_rows, dtype=np.int32)
+    offset = 0
+    for i, seq in enumerate(seqs):
+        seq = seq[:token_budget]
+        n = len(seq)
+        if offset + n > token_budget:
+            raise ValueError(
+                f"pack overflows token_budget={token_budget} at row {i} "
+                f"(offset {offset} + {n} tokens) — pack with "
+                "pack_token_budget first"
+            )
+        ids[0, offset : offset + n] = seq
+        mask[0, offset : offset + n] = 1
+        segments[0, offset : offset + n] = i + 1
+        positions[0, offset : offset + n] = np.arange(n, dtype=np.int32)
+        row_starts[i] = offset
+        offset += n
+    return {
+        "input_ids": ids,
+        "attention_mask": mask,
+        "segment_ids": segments,
+        "position_ids": positions,
+        "row_starts": row_starts,
+    }
 
 
 def inflight_pipeline(

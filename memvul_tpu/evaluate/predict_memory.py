@@ -26,6 +26,7 @@ import numpy as np
 from ..data.batching import (
     LABELS_SIAMESE,
     CachedEncoder,
+    _pad_block,
     batches_from_instances,
     bucket_batch_sizes,
     bucketed_batches_from_instances,
@@ -60,6 +61,9 @@ class SiamesePredictor:
         anchor_chunk: int = 128,
         anchor_match_impl: Optional[str] = None,
         aot_warmup: bool = True,
+        score_impl: str = "bucketed",
+        token_budget: Optional[int] = None,
+        max_rows_per_pack: Optional[int] = None,
     ) -> None:
         self.model = model
         self.mesh = mesh
@@ -67,6 +71,34 @@ class SiamesePredictor:
         self.anchor_chunk = anchor_chunk
         self.encoder = CachedEncoder(tokenizer, max_length=max_length)
         self.buckets = validate_buckets(buckets, max_length) if buckets else None
+        # ragged serve path (docs/ragged_serving.md): ONE compiled
+        # program over a fixed [1, token_budget] packed batch replaces
+        # the per-bucket program grid; warmup/scoring/swap all route on
+        # this knob, so the bucketed contract is untouched by default
+        if score_impl not in ("bucketed", "ragged"):
+            raise ValueError(
+                f"score_impl must be 'bucketed' or 'ragged', got {score_impl!r}"
+            )
+        if score_impl == "ragged" and mesh is not None:
+            raise ValueError(
+                "score_impl='ragged' serves a single-device predictor (its "
+                "packed batch has one row); scale out with serving replicas, "
+                "not a mesh"
+            )
+        self.score_impl = score_impl
+        if token_budget is None:
+            token_budget = 4 * max_length
+        if token_budget < max_length:
+            raise ValueError(
+                f"token_budget {token_budget} < max_length {max_length}: one "
+                "cap-length request must fit a pack"
+            )
+        self.token_budget = int(token_budget)
+        self.max_rows_per_pack = int(
+            max_rows_per_pack if max_rows_per_pack is not None else batch_size
+        )
+        if self.max_rows_per_pack < 1:
+            raise ValueError("max_rows_per_pack must be >= 1")
         # constant-token-budget batching: short buckets run bigger batches
         if self.buckets and tokens_per_batch:
             n_data = mesh.shape.get("data", 1) if mesh is not None else 1
@@ -101,10 +133,13 @@ class SiamesePredictor:
         self._build_score_fn()
 
     def _build_score_fn(self) -> None:
-        """(Re)build the jitted score program.  Reads
+        """(Re)build the jitted score programs.  Reads
         ``self.anchor_match_impl`` at trace time, so a degradation to
         "xla" only needs a fresh jit wrapper (old fused executables die
-        with the old wrapper's cache)."""
+        with the old wrapper's cache).  The ragged program shares the
+        ``score_trace_count`` probe: after a ragged warmup, ANY length
+        mix must dispatch without a new trace — the single-warm-program
+        contract the serving tests pin."""
 
         def _score(p, b, bank):
             self.score_trace_count += 1  # host-side, runs at trace only
@@ -116,6 +151,18 @@ class SiamesePredictor:
             )
 
         self._score_fn = jax.jit(_score)
+
+        def _score_ragged(p, sample, bank):
+            self.score_trace_count += 1  # host-side, runs at trace only
+            return anchor_probs(
+                self.model.apply(
+                    p, sample, bank, deterministic=True,
+                    anchor_impl=self.anchor_match_impl,
+                    method=type(self.model).score_ragged,
+                )
+            )
+
+        self._ragged_score_fn = jax.jit(_score_ragged)
 
     def _maybe_degrade_to_xla(self, error: BaseException) -> bool:
         """Mosaic/Pallas failures that escaped the trace-time fallback in
@@ -244,11 +291,52 @@ class SiamesePredictor:
             raise RuntimeError("call encode_anchors() first")
         return self.warmup_bank_shapes(self.anchor_bank)
 
+    def ragged_shape(self) -> Tuple[int, int]:
+        """The single (token_budget, max_rows) geometry the ragged score
+        program compiles at — every pack dispatches this one shape."""
+        return (self.token_budget, self.max_rows_per_pack)
+
+    def _ragged_warm_sample(self) -> Dict[str, np.ndarray]:
+        """A representative (content-irrelevant) pack at the warm
+        geometry — what ``lower().compile()`` keys the executable on."""
+        from ..data.batching import collate_ragged
+
+        return collate_ragged(
+            [[self.encoder.pad_id]], self.token_budget,
+            self.max_rows_per_pack, self.encoder.pad_id,
+        )
+
     def warmup_bank_shapes(self, bank) -> int:
         """:meth:`warmup_compile` against an explicit bank array — the
         serving hot-swap path warms a *replacement* bank's shapes here
         before installing it, so a bank of a new geometry still never
-        costs a mid-serve compile (docs/serving.md)."""
+        costs a mid-serve compile (docs/serving.md).
+
+        With ``score_impl="ragged"`` this warms exactly ONE program —
+        the packed ``[1, token_budget]`` score program that serves any
+        length mix — instead of the per-bucket grid
+        (docs/ragged_serving.md).  The bucketed ``score_instances``
+        path on such a predictor still works but compiles lazily."""
+        if self.score_impl == "ragged":
+            start = time.perf_counter()
+            tel = get_registry()
+            with tel.span("aot_warmup", shapes=1):
+                tel.progress()
+                try:
+                    self._ragged_score_fn.lower(
+                        self.params, self._ragged_warm_sample(), bank
+                    ).compile()
+                except Exception as e:
+                    if not self._maybe_degrade_to_xla(e):
+                        raise
+                    return self.warmup_bank_shapes(bank)
+            logger.info(
+                "AOT warmup: 1 ragged score program (budget=%d, max_rows=%d) "
+                "compiled in %.1fs — replaces the bucket grid",
+                self.token_budget, self.max_rows_per_pack,
+                time.perf_counter() - start,
+            )
+            return 1
         shapes = self.stream_shapes()
         start = time.perf_counter()
         tel = get_registry()
@@ -388,6 +476,72 @@ class SiamesePredictor:
                     meta["_anchor_index"] = int(idx)
                     meta["_anchor"] = self.anchor_labels[int(idx)]
             yield sliced, metas
+
+    def score_texts(
+        self,
+        texts: Sequence[str],
+        bank_array=None,
+        n_anchors: Optional[int] = None,
+    ) -> np.ndarray:
+        """Score raw texts against a bank through THIS predictor's
+        serving impl — bucketed texts route to their warmed bucket
+        shapes (the micro-batcher's ``_pad_block`` layout), ragged texts
+        pack into the single warmed ``[1, token_budget]`` program.  The
+        shadow scorer (bankops/shadow.py) calls this so a shadow score
+        is always computed the way the active service would have served
+        it, whichever impl is live.  Returns ``[len(texts), n_anchors]``
+        probabilities; ``bank_array``/``n_anchors`` default to the
+        predictor's own bank."""
+        bank = self.anchor_bank if bank_array is None else bank_array
+        n = self.n_anchors if n_anchors is None else int(n_anchors)
+        if bank is None:
+            raise RuntimeError("call encode_anchors() first")
+        if not texts:
+            return np.zeros((0, n), np.float32)
+        seqs = self.encoder.encode_many(list(texts))
+        out = np.zeros((len(texts), n), np.float32)
+        if self.score_impl == "ragged":
+            from ..data.batching import collate_ragged, pack_token_budget
+
+            budget, max_rows = self.token_budget, self.max_rows_per_pack
+            for pack in pack_token_budget(
+                [len(s) for s in seqs], budget, max_rows
+            ):
+                sample = collate_ragged(
+                    [seqs[i] for i in pack], budget, max_rows,
+                    self.encoder.pad_id,
+                )
+                probs = np.asarray(
+                    self._ragged_score_fn(self.params, sample, bank)
+                )[: len(pack), :n]
+                for row, i in zip(probs, pack):
+                    out[i] = row
+            return out
+        rows_by_length = {
+            length: rows for rows, length in self.stream_shapes()
+        }
+        lengths = sorted(rows_by_length)
+        groups: Dict[int, List[int]] = {}
+        for i, seq in enumerate(seqs):
+            length = next(
+                (b for b in lengths if b >= len(seq)), lengths[-1]
+            )
+            groups.setdefault(length, []).append(i)
+        for length in sorted(groups):
+            rows = rows_by_length[length]
+            indices = groups[length]
+            for start in range(0, len(indices), rows):
+                chunk = indices[start : start + rows]
+                sample = _pad_block(
+                    [seqs[i] for i in chunk], rows, self.encoder.pad_id, length
+                )
+                if self.mesh is not None:
+                    sample = shard_batch(sample, self.mesh)
+                dev = self._score_fn(self.params, sample, bank)
+                probs = np.asarray(dev)[: len(chunk), :n]
+                for row, i in zip(probs, chunk):
+                    out[i] = row
+        return out
 
     def predict_single(self, text: str) -> Dict[str, Union[float, str, int, Dict]]:
         """Score ONE report text and return the full attribution the

@@ -162,7 +162,7 @@ class BertSelfAttention(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, hidden, bias, deterministic: bool):
+    def __call__(self, hidden, bias, deterministic: bool, segment_ids=None):
         c = self.config
         head_dim = c.hidden_size // c.num_heads
 
@@ -177,6 +177,7 @@ class BertSelfAttention(nn.Module):
             query, key, value, bias=bias,
             dropout_rng=dropout_rng, dropout_rate=c.attention_dropout,
             deterministic=deterministic, impl=c.attention_impl,
+            segment_ids=segment_ids,
         )
         out = _dense_general(c, c.hidden_size, "output", axis=(-2, -1))(attn)
         out = nn.Dropout(c.hidden_dropout)(out, deterministic=deterministic)
@@ -189,9 +190,11 @@ class BertLayer(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, hidden, bias, deterministic: bool):
+    def __call__(self, hidden, bias, deterministic: bool, segment_ids=None):
         c = self.config
-        hidden = BertSelfAttention(c, name="attention")(hidden, bias, deterministic)
+        hidden = BertSelfAttention(c, name="attention")(
+            hidden, bias, deterministic, segment_ids
+        )
         inter = _dense(c, c.intermediate_size, "intermediate")(hidden)
         inter = nn.gelu(inter, approximate=False)
         out = _dense(c, c.hidden_size, "output")(inter)
@@ -211,8 +214,10 @@ class _ScanBody(nn.Module):
     collect: bool = False
 
     @nn.compact
-    def __call__(self, hidden, bias):
-        out = BertLayer(self.config, name="layer")(hidden, bias, self.deterministic)
+    def __call__(self, hidden, bias, segment_ids=None):
+        out = BertLayer(self.config, name="layer")(
+            hidden, bias, self.deterministic, segment_ids
+        )
         return out, (out if self.collect else None)
 
 
@@ -224,7 +229,7 @@ class BertEncoderStack(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, hidden, bias, deterministic: bool):
+    def __call__(self, hidden, bias, deterministic: bool, segment_ids=None):
         c = self.config
         collect = not c.last_layer_only
         if c.scan_layers:
@@ -236,14 +241,16 @@ class BertEncoderStack(nn.Module):
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=c.num_layers,
-                in_axes=(nn.broadcast,),
+                in_axes=(nn.broadcast, nn.broadcast),
             )(c, deterministic, collect, name="layers")
-            hidden, stacked = scanned(hidden, bias)
+            hidden, stacked = scanned(hidden, bias, segment_ids)
             return stacked if collect else hidden
         layer_cls = nn.remat(BertLayer, static_argnums=(3,)) if c.remat else BertLayer
         outputs = []
         for i in range(c.num_layers):
-            hidden = layer_cls(c, name=f"layer_{i}")(hidden, bias, deterministic)
+            hidden = layer_cls(c, name=f"layer_{i}")(
+                hidden, bias, deterministic, segment_ids
+            )
             if collect:
                 outputs.append(hidden)
         return jnp.stack(outputs) if collect else hidden
@@ -283,9 +290,14 @@ class BertEncoder(nn.Module):
         token_type_ids=None,
         deterministic: bool = True,
         position_ids=None,
+        segment_ids=None,
     ):
         c = self.config
-        if input_ids.shape[-1] > c.max_position_embeddings:
+        if position_ids is None and input_ids.shape[-1] > c.max_position_embeddings:
+            # with explicit position ids (the packed ragged batch, whose
+            # flat token row is LONGER than any one request) the caller
+            # owns keeping every id < max_position_embeddings — the
+            # packer restarts positions at each segment boundary
             raise ValueError(
                 f"sequence length {input_ids.shape[-1]} exceeds "
                 f"max_position_embeddings={c.max_position_embeddings}; "
@@ -298,9 +310,16 @@ class BertEncoder(nn.Module):
             hidden = BertEmbeddings(c, name="embeddings")(
                 input_ids, token_type_ids, deterministic, position_ids=position_ids
             )
-            bias = mask_to_bias(attention_mask, dtype=c.dtype)
+            # the ragged path masks attention on segment equality inside
+            # the kernel; the padding-mask bias is the bucketed path's
+            bias = (
+                None if segment_ids is not None
+                else mask_to_bias(attention_mask, dtype=c.dtype)
+            )
         with jax.named_scope("bert_layers"):
-            out = BertEncoderStack(c, name="encoder")(hidden, bias, deterministic)
+            out = BertEncoderStack(c, name="encoder")(
+                hidden, bias, deterministic, segment_ids
+            )
         if c.last_layer_only:
             return out
         with jax.named_scope("scalar_mix"):

@@ -90,6 +90,55 @@ class MemoryModel(nn.Module):
                 pooled = self.header(pooled, deterministic=deterministic)
         return pooled
 
+    def encode_ragged(self, sample, deterministic: bool = True) -> jax.Array:
+        """Packed flat batch → per-request embeddings [max_rows, D].
+
+        ``sample`` is one :func:`~memvul_tpu.data.batching.collate_ragged`
+        pack: a ``[1, token_budget]`` token row whose ``segment_ids``
+        block attention on request boundaries and whose ``position_ids``
+        restart per request, plus the ``row_starts`` table.  The encoder
+        runs ONCE over the flat row; segment-aware pooling then gathers
+        each request's CLS position out of it and feeds the gathered
+        rows through the same pooler/header parameters the padded path
+        uses — so a request's embedding matches its padded-batch
+        embedding up to attention reduction order (docs/ragged_serving.md).
+        Rows past the pack's real count gather position 0 and are sliced
+        off host-side."""
+        with jax.named_scope("bert_encode_ragged"):
+            hidden = self.encoder(
+                sample["input_ids"],
+                sample["attention_mask"],
+                sample.get("token_type_ids"),
+                deterministic=deterministic,
+                position_ids=sample["position_ids"],
+                segment_ids=sample["segment_ids"],
+            )
+        with jax.named_scope("ragged_row_gather"):
+            # [1, budget, H] → [max_rows, 1, H]: each row's CLS token,
+            # shaped so the pooler's hidden[:, 0] sees one CLS per row
+            cls = jnp.take(hidden[0], sample["row_starts"], axis=0)[:, None, :]
+        with jax.named_scope("pooler"):
+            pooled = self.pooler(cls, deterministic=deterministic)
+        if self.use_header:
+            with jax.named_scope("header"):
+                pooled = self.header(pooled, deterministic=deterministic)
+        return pooled
+
+    def score_ragged(
+        self,
+        sample,
+        anchors: jax.Array,
+        deterministic: bool = True,
+        anchor_impl: Optional[str] = None,
+    ) -> jax.Array:
+        """Packed flat batch × bank [A, D] → anchor logits
+        [max_rows, A, 2] — the ragged twin of ``__call__(sample1,
+        anchors=...)`` (invoked via ``model.apply(...,
+        method=model.score_ragged)`` by the predictor's ragged score
+        program)."""
+        u = self.encode_ragged(sample, deterministic=deterministic)
+        return self.match_anchors(u, anchors, impl=anchor_impl)
+
     def pair_logits(self, u: jax.Array, v: jax.Array) -> jax.Array:
         """[B, D] × [B, D] → [B, 2] (training path)."""
         with jax.named_scope("pair_logits"):

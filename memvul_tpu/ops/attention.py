@@ -33,12 +33,29 @@ def dot_product_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     impl: str = "xla",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Scaled dot-product attention.
 
     query/key/value: [B, T, H, Dh]; bias broadcastable to [B, H, Tq, Tk].
     Returns [B, Tq, H, Dh] in the dtype of ``query``.
+
+    ``segment_ids`` ([B, T] int32, 0 = dead padding) switches to the
+    ragged packed-batch path (docs/ragged_serving.md): attention is
+    masked on segment boundaries instead of ``bias``, through the
+    segment-masked Pallas kernel on TPU and the XLA formulation over an
+    explicit segment bias elsewhere.  Inference-only — it overrides
+    ``impl`` and supports no dropout (the packed path never trains).
     """
+    if segment_ids is not None:
+        if not deterministic and dropout_rate > 0.0:
+            raise ValueError(
+                "ragged segment attention is an inference path — "
+                "attention dropout is not supported with segment_ids"
+            )
+        from .pallas.ragged_attention import ragged_attention_or_fallback
+
+        return ragged_attention_or_fallback(query, key, value, segment_ids)
     if impl == "flash":
         if deterministic or dropout_rate == 0.0:
             from .pallas.flash_attention import flash_attention_or_fallback

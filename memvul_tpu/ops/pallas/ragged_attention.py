@@ -1,0 +1,276 @@
+"""Ragged (segment-masked) flash attention for the packed serve path.
+
+The ragged serving path (docs/ragged_serving.md) packs many
+variable-length requests into ONE flat token row — ``input_ids`` of a
+fixed ``[1, token_budget]`` shape with a ``segment_ids`` row table
+marking which request each position belongs to (0 = dead padding).
+Attention must then be blocked on request boundaries: a token may only
+attend to keys carrying its own segment id, never across requests that
+merely happen to be neighbours in the pack.
+
+This module extends the blockwise kernel in :mod:`.flash_kernel` from
+key-only *padding* masks to full segment masking:
+
+* the Pallas kernel streams key/value blocks through VMEM exactly like
+  the flash kernel (O(T·D) footprint, online softmax) and applies the
+  ``q_seg == k_seg & k_seg > 0`` mask per score block — the [T, T]
+  segment mask never materializes in HBM, which matters because the
+  packed budget is the one sequence length in the system that *grows*
+  with batching (the bucketed path's [B, H, L, L] bias is per-bucket
+  small; the ragged path's would be [1, H, budget, budget]);
+* segment ids ride in lane-/sublane-replicated layouts ([B, Tq, 128]
+  for the query side, [B, 8, Tk] for the key side — the same
+  replication trick the flash kernel's m/l scratch uses) so the
+  per-block equality is a 2D broadcast Mosaic can lower;
+* non-TPU backends fall back to the XLA formulation over an explicit
+  [B, 1, Tq, Tk] segment bias — mathematically identical, and the
+  kernel itself is exercised on CPU via interpret mode in
+  tests/test_ragged_serving.py.
+
+Forward-only by design: the ragged path serves inference (the packed
+program is never differentiated); training keeps the bucketed pair
+batches of PR 5.  Numerics match the XLA path: scores and softmax in
+float32, output cast back to the query dtype.  Dead positions (segment
+0) see an all-masked row and produce the same uniform-average artifact
+as XLA softmax under a fully-masked bias — the row-table gather drops
+them before anything downstream looks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_kernel import _CompilerParams, _NEG_INF, _fit_block
+
+# replication widths for the segment-id operands (see module docstring):
+# query-side ids replicate across the 128-lane axis, key-side ids across
+# the 8-sublane axis, so each block slice is a legal (8,128)-tiled ref
+_LANES = 128
+_SUBLANES = 8
+
+
+def segment_bias(segment_ids: jax.Array, dtype=jnp.float32) -> jax.Array:
+    """[B, T] segment ids → additive bias [B, 1, Tq, Tk].
+
+    Position q may attend to position k iff they carry the same non-zero
+    segment id; everything else (cross-request pairs and dead padding)
+    gets the dtype's finite min, which a float32 softmax turns into an
+    exact zero weight — the same convention as
+    :func:`~memvul_tpu.ops.attention.mask_to_bias`, so the packed scores
+    match the bucketed path's padded scores bit-for-bit in the real
+    rows."""
+    neg = jnp.finfo(dtype).min
+    q = segment_ids[:, :, None]  # [B, Tq, 1]
+    k = segment_ids[:, None, :]  # [B, 1, Tk]
+    allowed = (q == k) & (k > 0)
+    return jnp.where(allowed[:, None, :, :], 0.0, neg).astype(dtype)
+
+
+def _ragged_fwd_kernel(
+    q_seg_ref,  # [1, block_q, 128] int32 — lane-replicated query segments
+    k_seg_ref,  # [1, 8, block_k] int32 — sublane-replicated key segments
+    q_ref,      # [1, block_q, d]
+    k_ref,      # [1, block_k, d]
+    v_ref,      # [1, block_k, d]
+    out_ref,    # [1, block_q, d]
+    m_scratch,    # [block_q, 128] f32 running max (lane-replicated)
+    l_scratch,    # [block_q, 128] f32 running denominator
+    acc_scratch,  # [block_q, d] f32 output accumulator
+    *,
+    scale: float,
+    num_k_blocks: int,
+):
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [block_q, block_k]
+    s = s * scale
+
+    # the segment mask: [block_q, 1] == [1, block_k] broadcasts to the
+    # score block's shape without any 1D iota/transpose Mosaic would
+    # reject; k_seg > 0 additionally kills dead (padding) keys
+    q_seg = q_seg_ref[0, :, :1]   # [block_q, 1]
+    k_seg = k_seg_ref[0, :1, :]   # [1, block_k]
+    mask = (q_seg == k_seg) & (k_seg > 0)
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_scratch[:, :1]  # [block_q, 1]
+    l_prev = l_scratch[:, :1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    correction = jnp.exp(m_prev - m_new)  # [block_q, 1]
+    p = jnp.exp(s - m_new)  # [block_q, block_k]
+    # a fully-masked score block is exp(0) = 1 everywhere (NEG_INF is the
+    # finite float32 min, so the subtraction stays finite); those uniform
+    # weights only ever land on dead rows, whose output no one gathers
+    l_new = l_prev * correction + p.sum(axis=-1, keepdims=True)
+    m_scratch[:] = jnp.broadcast_to(m_new, m_scratch.shape)
+    l_scratch[:] = jnp.broadcast_to(l_new, l_scratch.shape)
+
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, d]
+    acc_scratch[:] = acc_scratch[:] * correction + pv
+
+    @pl.when(kj == num_k_blocks - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scratch[:, :1], 1e-30)
+        out_ref[0] = (acc_scratch[:] / denom).astype(out_ref.dtype)
+
+
+def ragged_flash_attention(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    segment_ids: jax.Array,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Segment-masked blockwise attention.  [B, T, H, D] in/out.
+
+    ``segment_ids`` is [B, T] int32: equal non-zero values attend to each
+    other, 0 marks dead padding.  Forward-only (inference path).
+    ``interpret`` defaults to True off-TPU so tests exercise the kernel
+    logic anywhere; default blocks are smaller than the flash kernel's
+    because the packed budget replaces the batch axis (grid parallelism
+    comes from q-blocks, not rows).
+    """
+    if query.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D], got {query.shape}")
+    if segment_ids.shape != query.shape[:2]:
+        raise ValueError(
+            f"segment_ids {segment_ids.shape} must match [B, T] "
+            f"{query.shape[:2]}"
+        )
+    if interpret is None:
+        from ...utils.platform import is_tpu_backend
+
+        interpret = not is_tpu_backend()
+    b, t_q, h, d = query.shape
+    t_k = key.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    segment_ids = segment_ids.astype(jnp.int32)
+
+    block_q = _fit_block(block_q, t_q)
+    block_k = _fit_block(block_k, t_k)
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_k) % block_k
+    seg_q, seg_k = segment_ids, segment_ids
+    if pad_q:
+        query = jnp.pad(query, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        # padded query rows keep segment 0 → fully masked → dropped output
+        seg_q = jnp.pad(seg_q, ((0, 0), (0, pad_q)))
+    if pad_k:
+        key = jnp.pad(key, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        value = jnp.pad(value, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        seg_k = jnp.pad(seg_k, ((0, 0), (0, pad_k)))  # 0 = never attended
+    tq_p, tk_p = t_q + pad_q, t_k + pad_k
+
+    # [B, T, H, D] -> [B*H, T, D] (one attention problem per batch-head)
+    qt = query.transpose(0, 2, 1, 3).reshape(b * h, tq_p, d)
+    kt = key.transpose(0, 2, 1, 3).reshape(b * h, tk_p, d)
+    vt = value.transpose(0, 2, 1, 3).reshape(b * h, tk_p, d)
+
+    # replicated segment-id layouts (module docstring): blocks sliced
+    # from these are (sublane, lane)-legal without any in-kernel reshape
+    q_seg_rep = jax.lax.broadcast_in_dim(
+        seg_q, (b, tq_p, _LANES), (0, 1)
+    )
+    k_seg_rep = jax.lax.broadcast_in_dim(
+        seg_k, (b, _SUBLANES, tk_p), (0, 2)
+    )
+
+    num_q_blocks = tq_p // block_q
+    num_k_blocks = tk_p // block_k
+
+    kernel = functools.partial(
+        _ragged_fwd_kernel, scale=scale, num_k_blocks=num_k_blocks
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q_blocks, num_k_blocks),
+        in_specs=[
+            # segment ids are per-batch (shared across heads): row =
+            # bh // h via lax.div, same Mosaic-friendly index map as the
+            # flash kernel's bias spec
+            pl.BlockSpec(
+                (1, block_q, _LANES),
+                lambda bh, qi, kj: (jax.lax.div(bh, h), qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, _SUBLANES, block_k),
+                lambda bh, qi, kj: (jax.lax.div(bh, h), 0, kj),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_q, d), lambda bh, qi, kj: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda bh, qi, kj: (bh, kj, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, qi, kj: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), query.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q_seg_rep, k_seg_rep, qt, kt, vt)
+
+    out = out.reshape(b, h, tq_p, d).transpose(0, 2, 1, 3)
+    if pad_q:
+        out = out[:, :t_q]
+    return out
+
+
+def ragged_attention_or_fallback(
+    query: jax.Array,
+    key: jax.Array,
+    value: jax.Array,
+    segment_ids: jax.Array,
+) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU, XLA over an explicit segment bias
+    elsewhere (mathematically identical; the bias materializes
+    [B, 1, T, T], which is fine off-TPU where T is test-sized)."""
+    from ...utils.platform import is_tpu_backend
+
+    if is_tpu_backend():
+        with jax.named_scope("ragged_flash_attention"):
+            return ragged_flash_attention(query, key, value, segment_ids)
+    from ..attention import _xla_attention
+
+    with jax.named_scope("ragged_xla_attention"):
+        return _xla_attention(
+            query, key, value, segment_bias(segment_ids, jnp.float32),
+            None, 0.0, True,
+        )

@@ -18,7 +18,11 @@ Three cooperating pieces:
   to the smallest warmed length bucket covering its token count, and
   pads every micro-batch to the warmed (rows, bucket) shape with the
   same ``_pad_block`` the offline collator uses — so a served score is
-  bitwise-identical to the offline score of the same text;
+  bitwise-identical to the offline score of the same text.  With a
+  ``score_impl="ragged"`` predictor the pull instead coalesces by
+  token budget: it is packed into fixed ``[1, token_budget]`` flat
+  batches and ONE warmed segment-masked program serves any length mix
+  (scores ≤1e-6 vs the bucketed path; docs/ragged_serving.md);
 * **admission control** — the queue is bounded (``max_queue``); on
   overflow the *oldest* queued request is shed (it is the one most
   likely to miss its deadline anyway) with status ``"shed"`` instead of
@@ -203,6 +207,16 @@ class ScoringService:
             length: rows for rows, length in predictor.stream_shapes()
         }
         self._lengths = sorted(self._rows_by_length)
+        # ragged serve path (docs/ragged_serving.md): the predictor's
+        # score_impl decides how a pull dispatches — bucket routing over
+        # the warmed grid, or token-budget packing into the single
+        # warmed [1, token_budget] program.  Admission, deadlines,
+        # drain, swap and the shadow tap are impl-independent.
+        self._score_impl = getattr(predictor, "score_impl", "bucketed")
+        if self._score_impl == "ragged":
+            self._token_budget, self._max_rows = predictor.ragged_shape()
+        else:
+            self._token_budget = self._max_rows = 0
         self._bank = _BankVersion(
             version=1,
             array=predictor.anchor_bank,
@@ -612,6 +626,24 @@ class ScoringService:
             bank = self._bank  # ONE snapshot for the whole pull
         encoder = self.predictor.encoder
         seqs = encoder.encode_many([r.text for r in live])
+        self._count_truncated(live, seqs)
+        if self._score_impl == "ragged":
+            # coalesce by token budget, not rows-per-bucket: the pull is
+            # packed into as few fixed-[1, token_budget] batches as the
+            # greedy in-order packer allows — one warm program serves
+            # any length mix (docs/ragged_serving.md)
+            from ..data.batching import pack_token_budget
+
+            for pack in pack_token_budget(
+                [len(seq) for seq in seqs],
+                self._token_budget, self._max_rows,
+            ):
+                if self._killed.is_set():
+                    return  # abandoned — the kill sweep takes over
+                self._score_chunk(
+                    [(live[i], seqs[i]) for i in pack], bank
+                )
+            return
         groups: Dict[int, List[Tuple[_Request, List[int]]]] = {}
         for request, seq in zip(live, seqs):
             groups.setdefault(self._bucket_for(len(seq)), []).append(
@@ -623,7 +655,9 @@ class ScoringService:
             for start in range(0, len(group), rows):
                 if self._killed.is_set():
                     return  # abandoned — the kill sweep takes over
-                self._score_chunk(group[start : start + rows], length, rows, bank)
+                self._score_chunk(
+                    group[start : start + rows], bank, length=length, rows=rows
+                )
 
     def _bucket_for(self, n_tokens: int) -> int:
         """Smallest warmed bucket covering the token count (over-long
@@ -634,32 +668,71 @@ class ScoringService:
                 return length
         return self._lengths[-1]
 
+    def _count_truncated(self, live: Sequence[_Request], seqs) -> None:
+        """``serve.truncated``: requests whose text tokenized PAST the
+        serving cap and was clamped into the largest bucket/budget —
+        the serving twin of training's ``data.truncated_sequences``
+        (the clamp used to be silent here).  Only sequences sitting at
+        the cap pay the re-encode probe; encoders without one (test
+        fakes) skip the count."""
+        probe = getattr(self.predictor.encoder, "encodes_beyond", None)
+        if probe is None or not seqs:
+            return
+        cap = self.predictor.encoder.max_length
+        if self._score_impl == "ragged":
+            cap = min(cap, self._token_budget)
+        truncated = sum(
+            1
+            for request, seq in zip(live, seqs)
+            if len(seq) >= cap and probe(request.text, cap)
+        )
+        if truncated:
+            self._tel.counter("serve.truncated").inc(truncated)
+
     def _score_chunk(
         self,
         chunk: Sequence[Tuple[_Request, List[int]]],
-        length: int,
-        rows: int,
         bank: _BankVersion,
+        length: Optional[int] = None,
+        rows: Optional[int] = None,
     ) -> None:
-        """One device dispatch at a warmed (rows, length) shape.  The
-        ``serve.batch`` fault point fires inside the retried window;
+        """One device dispatch at a warmed shape — a (rows, length)
+        bucket block, or (ragged) one packed [1, token_budget] batch.
+        The ``serve.batch`` fault point fires inside the retried window;
         retry exhaustion (or a non-transient failure) dead-letters the
         chunk — every request resolves ``"error"`` with the reason —
         rather than hanging its clients."""
         from ..parallel.mesh import shard_batch
 
         tel = self._tel
-        sample = _pad_block(
-            [seq for _, seq in chunk], rows, self.predictor.encoder.pad_id, length
-        )
-        if self.predictor.mesh is not None:
-            sample = shard_batch(sample, self.predictor.mesh)
+        if self._score_impl == "ragged":
+            from ..data.batching import collate_ragged
+
+            sample = collate_ragged(
+                [seq for _, seq in chunk], self._token_budget,
+                self._max_rows, self.predictor.encoder.pad_id,
+            )
+            occupancy_rows = self._max_rows
+            padded_tokens = self._token_budget
+            real_tokens = sum(
+                min(len(seq), self._token_budget) for _, seq in chunk
+            )
+            score_fn = self.predictor._ragged_score_fn
+        else:
+            sample = _pad_block(
+                [seq for _, seq in chunk], rows,
+                self.predictor.encoder.pad_id, length,
+            )
+            if self.predictor.mesh is not None:
+                sample = shard_batch(sample, self.predictor.mesh)
+            occupancy_rows = rows
+            padded_tokens = rows * length
+            real_tokens = sum(min(len(seq), length) for _, seq in chunk)
+            score_fn = self.predictor._score_fn
 
         def once():
             faults.fault_point("serve.batch")
-            return self.predictor._score_fn(
-                self.predictor.params, sample, bank.array
-            )
+            return score_fn(self.predictor.params, sample, bank.array)
 
         start = time.perf_counter()
         try:
@@ -687,7 +760,16 @@ class ScoringService:
         tel.histogram("serve.batch_latency_s").observe(
             time.perf_counter() - start
         )
-        tel.histogram("serve.batch_occupancy").observe(len(chunk) / rows)
+        tel.histogram("serve.batch_occupancy").observe(
+            len(chunk) / occupancy_rows
+        )
+        # the padding-efficiency ledger (docs/ragged_serving.md):
+        # real tokens the requests carried vs token slots the dispatched
+        # shape paid for — telemetry-report derives
+        # serve.real_token_utilization from the pair, and the serve
+        # microbench A/B reads them per path
+        tel.counter("serve.tokens_real").inc(real_tokens)
+        tel.counter("serve.tokens_padded").inc(padded_tokens)
         tel.counter("serve.batches").inc()
         tel.counter("serve.served").inc(len(chunk))
         tel.progress()

@@ -344,6 +344,19 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
                 f"  data.encode_cache_hit_rate = {hits / total:.3f}"
                 f" ({int(hits)}/{int(total)} lookups)"
             )
+        # derived: serve-path padding efficiency — real tokens served vs
+        # token slots the dispatched shapes paid for (the ragged path's
+        # headline number, docs/ragged_serving.md)
+        try:
+            real = float(counters["serve.tokens_real"])
+            padded = float(counters["serve.tokens_padded"])
+        except (KeyError, TypeError, ValueError):
+            padded = 0.0
+        if padded > 0:
+            lines.append(
+                f"  serve.real_token_utilization = {real / padded:.3f}"
+                f" ({int(real)}/{int(padded)} token slots)"
+            )
     gauges = summary.get("gauges") or {}
     if gauges:
         lines.append("")

@@ -1,0 +1,626 @@
+"""Ragged serve path (docs/ragged_serving.md).
+
+The acceptance contract this file pins:
+
+* **kernel parity** — the segment-masked Pallas kernel (interpret mode
+  on CPU) matches the masked jnp reference over random packs, block
+  boundaries, batch > 1, and bf16;
+* **packing** — ``pack_token_budget`` is a pure function of the input
+  order (no row lost/duplicated, every pack within budget/row caps,
+  sealed packs independent of what follows), and ``collate_ragged``'s
+  real-row content is invariant to trailing dead rows — the hypothesis
+  suite (optional tier, ``importorskip``);
+* **model parity** — a request's packed embedding/scores match its
+  padded-batch embedding/scores ≤1e-6;
+* **single warm program** — a ragged predictor AOT-warms exactly ONE
+  program and ``score_trace_count`` stays flat for ANY length mix,
+  including a 200-concurrent mixed-length served load whose scores
+  match the bucketed path ≤1e-6;
+* **satellites** — ``serve.truncated`` counts clamped requests;
+  shadow scoring routes through the active impl and its deltas are
+  impl-invariant; the lint catches packer/ragged calls landing on
+  handler/router classes; ``BENCH_MICRO=serve`` A/B emits the
+  real-token ledger with ragged utilization above bucketed.
+"""
+
+import json
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from memvul_tpu import telemetry
+from memvul_tpu.data.batching import (
+    collate_ragged,
+    pack_token_budget,
+)
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.ops.attention import _xla_attention
+from memvul_tpu.ops.pallas.ragged_attention import (
+    ragged_flash_attention,
+    segment_bias,
+)
+from memvul_tpu.resilience import faults
+from memvul_tpu.serving import InprocessClient, ScoringService, ServiceConfig
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("ragged"), seed=11)
+
+
+@pytest.fixture(scope="module")
+def setup(ws):
+    """One tiny model + a bucketed and a ragged predictor SHARING its
+    params — the parity pair every serving test scores against (their
+    jit caches persist across tests, the warmed-program reuse the
+    service relies on)."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    anchors = list(reader.read_anchors(ws["paths"]["anchors"]))
+    bucketed = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=8, max_length=48, buckets=[16, 48],
+    )
+    bucketed.encode_anchors(anchors)
+    ragged = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=8, max_length=48,
+        score_impl="ragged", token_budget=96, max_rows_per_pack=8,
+    )
+    ragged.encode_anchors(anchors)
+    texts = [
+        inst["text1"]
+        for inst in reader.read(ws["paths"]["test"], split="test")
+    ]
+    return {
+        "model": model, "params": params, "reader": reader,
+        "anchors": anchors, "texts": texts,
+        "bucketed": bucketed, "ragged": ragged, "tokenizer": ws["tokenizer"],
+    }
+
+
+@pytest.fixture()
+def tel(tmp_path):
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    yield registry
+    telemetry.reset()
+    faults.reset()
+
+
+def _random_segments(rng, t, n_rows, batch=1):
+    """A plausible pack layout: rows laid end-to-end, 0-padded tail."""
+    seg = np.zeros((batch, t), np.int32)
+    for b in range(batch):
+        offset = 0
+        for i in range(n_rows):
+            n = int(rng.integers(1, max(2, t // n_rows)))
+            if offset + n > t:
+                break
+            seg[b, offset : offset + n] = i + 1
+            offset += n
+    return seg
+
+
+# -- ragged kernel parity (interpret mode) ------------------------------------
+
+@pytest.mark.parametrize("t", [128, 160])  # 160: not a block multiple
+def test_ragged_kernel_matches_masked_reference(t):
+    rng = np.random.default_rng(t)
+    b, h, d = 2, 4, 32
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5, jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    seg = jnp.asarray(_random_segments(rng, t, n_rows=5, batch=b))
+    out = ragged_flash_attention(q, k, v, seg, block_q=128, block_k=128,
+                                 interpret=True)
+    ref = _xla_attention(q, k, v, segment_bias(seg), None, 0.0, True)
+    live = np.asarray(seg) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[live], np.asarray(ref)[live], atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ragged_kernel_bf16_close_to_fp32_reference():
+    rng = np.random.default_rng(3)
+    b, t, h, d = 1, 128, 2, 32
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)) * 0.5, jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    seg = jnp.asarray(_random_segments(rng, t, n_rows=4))
+    out = ragged_flash_attention(q, k, v, seg, interpret=True)
+    ref = _xla_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+        segment_bias(seg), None, 0.0, True,
+    )
+    assert out.dtype == jnp.bfloat16
+    live = np.asarray(seg) > 0
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32)[live], np.asarray(ref)[live],
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_segment_bias_semantics():
+    """Same non-zero segment attends; cross-segment and dead padding
+    never do — the mask the kernel applies blockwise."""
+    seg = jnp.asarray([[1, 1, 2, 0]], jnp.int32)
+    bias = np.asarray(segment_bias(seg))[0, 0]  # [Tq, Tk]
+    neg = np.finfo(np.float32).min
+    assert bias[0, 1] == 0.0 and bias[1, 0] == 0.0  # within segment 1
+    assert bias[2, 2] == 0.0                         # within segment 2
+    assert bias[0, 2] == neg and bias[2, 0] == neg   # across segments
+    assert (bias[:, 3] == neg).all()                 # dead key: never seen
+    assert (bias[3, :] == neg).all()                 # dead query: sees nothing
+
+
+def test_ragged_kernel_rejects_bad_segment_shape():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 64, 2, 16)), jnp.float32)
+    with pytest.raises(ValueError, match="segment_ids"):
+        ragged_flash_attention(
+            q, q, q, jnp.zeros((1, 32), jnp.int32), interpret=True
+        )
+
+
+# -- token-budget packer -------------------------------------------------------
+
+def test_pack_token_budget_order_budget_and_row_caps():
+    # budget seals: 40+40 fits 96, +30 overflows -> new pack
+    assert pack_token_budget([40, 40, 30], 96, 8) == [[0, 1], [2]]
+    # row cap seals even when tokens fit
+    assert pack_token_budget([1, 1, 1, 1, 1], 96, 2) == [[0, 1], [2, 3], [4]]
+    # strictly in-order: a later short row never backfills an old pack
+    assert pack_token_budget([90, 90, 2], 96, 8) == [[0], [1, 2]]
+    # tail flush: the last partial pack is emitted
+    assert pack_token_budget([5], 96, 8) == [[0]]
+    assert pack_token_budget([], 96, 8) == []
+    # over-budget rows clamp to one full pack instead of crashing
+    assert pack_token_budget([500], 96, 8) == [[0]]
+
+
+def test_pack_and_collate_validation():
+    with pytest.raises(ValueError, match="token_budget"):
+        pack_token_budget([1], 0, 8)
+    with pytest.raises(ValueError, match="max_rows"):
+        pack_token_budget([1], 96, 0)
+    with pytest.raises(ValueError, match="max_rows"):
+        collate_ragged([[1]] * 3, 96, 2, pad_id=0)
+    with pytest.raises(ValueError, match="overflows token_budget"):
+        collate_ragged([[1] * 50, [2] * 50], 96, 8, pad_id=0)
+
+
+def test_collate_ragged_layout():
+    seqs = [[7, 8, 9], [4, 5]]
+    sample = collate_ragged(seqs, 12, 4, pad_id=0)
+    ids, seg = sample["input_ids"][0], sample["segment_ids"][0]
+    pos, mask = sample["position_ids"][0], sample["attention_mask"][0]
+    assert ids.tolist() == [7, 8, 9, 4, 5, 0, 0, 0, 0, 0, 0, 0]
+    assert seg.tolist() == [1, 1, 1, 2, 2, 0, 0, 0, 0, 0, 0, 0]
+    assert pos.tolist() == [0, 1, 2, 0, 1, 0, 0, 0, 0, 0, 0, 0]
+    assert mask.tolist() == [1, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0]
+    assert sample["row_starts"].tolist() == [0, 3, 0, 0]
+    for v in sample.values():
+        assert v.dtype == np.int32
+
+
+def test_packer_properties_hypothesis():
+    """Property (hypothesis): any length multiset packs with no row
+    lost/duplicated, every pack within the budget and row caps, sealed
+    packs are a pure function of the prefix that produced them, and
+    collation is invariant to trailing dead rows."""
+    pytest.importorskip("hypothesis")  # property tier is optional (pyproject [test])
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=80, deadline=None)
+    @given(
+        st.lists(st.integers(min_value=1, max_value=64), max_size=40),
+        st.integers(min_value=8, max_value=96),
+        st.integers(min_value=1, max_value=12),
+    )
+    def check(lengths, budget, max_rows):
+        packs = pack_token_budget(lengths, budget, max_rows)
+        # partition: every row in exactly one pack, order preserved
+        flat = [i for pack in packs for i in pack]
+        assert flat == list(range(len(lengths)))
+        for pack in packs:
+            assert len(pack) <= max_rows
+            assert sum(min(lengths[i], budget) for i in pack) <= budget
+        # prefix purity: sealed packs never depend on later rows
+        if len(packs) > 1:
+            prefix = [i for pack in packs[:-1] for i in pack]
+            again = pack_token_budget(
+                [lengths[i] for i in prefix], budget, max_rows
+            )
+            assert again == packs[:-1]
+        # trailing-dead-row invariance: growing max_rows (more dead
+        # rows in the collated pack) changes nothing a real row sees
+        if packs and len(packs[0]) < max_rows:
+            seqs = [[1] * lengths[i] for i in packs[0]]
+            a = collate_ragged(seqs, budget, max_rows, pad_id=0)
+            b = collate_ragged(seqs, budget, max_rows + 3, pad_id=0)
+            for key in ("input_ids", "attention_mask", "segment_ids",
+                        "position_ids"):
+                np.testing.assert_array_equal(a[key], b[key])
+            np.testing.assert_array_equal(
+                a["row_starts"][: len(seqs)], b["row_starts"][: len(seqs)]
+            )
+
+    check()
+
+
+# -- model / predictor parity --------------------------------------------------
+
+def test_encode_ragged_matches_padded_encode(setup):
+    """Segment-aware pooling pulls each request's embedding out of the
+    flat pack bit-for-bit equal to its padded-batch embedding (same
+    positions, same masked softmax zeros, same pooler/header params)."""
+    from memvul_tpu.data.batching import _pad_block
+
+    model, params = setup["model"], setup["params"]
+    enc = setup["bucketed"].encoder
+    seqs = enc.encode_many(setup["texts"][:5])
+    sample = collate_ragged(seqs, 128, 8, enc.pad_id)
+    u_ragged = np.asarray(
+        model.apply(params, sample, method=model.encode_ragged)
+    )[: len(seqs)]
+    u_padded = np.asarray(
+        model.apply(
+            params, _pad_block(seqs, len(seqs), enc.pad_id, 48),
+            method=model.encode,
+        )
+    )
+    np.testing.assert_allclose(u_ragged, u_padded, atol=1e-6, rtol=0)
+
+
+def test_score_texts_parity_bucketed_vs_ragged(setup):
+    """The tentpole parity gate: the SAME texts score ≤1e-6 identical
+    through the bucketed grid and the single packed program."""
+    texts = [setup["texts"][i % len(setup["texts"])] for i in range(60)]
+    want = setup["bucketed"].score_texts(texts)
+    got = setup["ragged"].score_texts(texts)
+    np.testing.assert_allclose(got, want, atol=1e-6, rtol=0)
+
+
+def test_ragged_warmup_is_single_program_and_traces_stay_flat(setup):
+    """One AOT-warmed program serves ANY length mix with zero new
+    traces — the single-warm-program contract replacing the bucket
+    grid."""
+    ragged = setup["ragged"]
+    assert ragged.warmup_bank_shapes(ragged.anchor_bank) == 1
+    traces = ragged.score_trace_count
+    texts = setup["texts"]
+    # adversarial mixes: singletons, short-only, long-only, shuffled
+    mixes = [
+        texts[:1],
+        sorted(texts[:20], key=len)[:10],
+        sorted(texts[:20], key=len)[-10:],
+        [texts[(7 * i) % len(texts)] for i in range(33)],
+    ]
+    for mix in mixes:
+        ragged.score_texts(mix)
+    assert ragged.score_trace_count == traces
+
+
+def test_predictor_ragged_validation(setup):
+    model, params = setup["model"], setup["params"]
+    tok = setup["tokenizer"]
+    with pytest.raises(ValueError, match="score_impl"):
+        SiamesePredictor(model, params, tok, score_impl="raggedy")
+    with pytest.raises(ValueError, match="token_budget"):
+        SiamesePredictor(
+            model, params, tok, max_length=48,
+            score_impl="ragged", token_budget=32,
+        )
+    with pytest.raises(ValueError, match="single-device"):
+        SiamesePredictor(
+            model, params, tok, mesh=object(), score_impl="ragged"
+        )
+
+
+# -- serving acceptance --------------------------------------------------------
+
+def test_ragged_service_concurrent_mixed_load_parity_one_warm_program(
+    setup, tel
+):
+    """200 concurrent mixed-length requests through a RAGGED service:
+    every response matches the bucketed path ≤1e-6, zero mid-serve
+    recompiles, and the padding ledger shows the packed shapes."""
+    bucketed, ragged = setup["bucketed"], setup["ragged"]
+    n = 200
+    picks = [setup["texts"][i % len(setup["texts"])] for i in range(n)]
+    expected = bucketed.score_texts(picks)
+    traces_before = ragged.score_trace_count
+
+    service = ScoringService(
+        ragged,
+        config=ServiceConfig(
+            max_batch=8, max_wait_ms=3.0, max_queue=1000,
+            default_deadline_ms=30000.0,
+        ),
+    )
+    client = InprocessClient(service)
+    results = {}
+    lock = threading.Lock()
+
+    def worker(indices):
+        for i in indices:
+            response = client.score(picks[i])
+            with lock:
+                results[i] = response
+
+    threads = [
+        threading.Thread(target=worker, args=(range(k, n, 16),))
+        for k in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    service.drain()
+
+    assert len(results) == n
+    labels = ragged.anchor_labels
+    for i in range(n):
+        assert results[i]["status"] == "ok"
+        got = np.array(
+            [results[i]["predict"][label] for label in labels], np.float32
+        )
+        np.testing.assert_allclose(got, expected[i], atol=1e-6, rtol=0)
+    # one warm program served the whole mixed-length load
+    assert ragged.score_trace_count == traces_before
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.served"] == n
+    assert counters["serve.requests"] == n
+    # padding ledger: every dispatch paid exactly token_budget slots
+    assert counters["serve.tokens_padded"] % ragged.token_budget == 0
+    assert 0 < counters["serve.tokens_real"] <= counters["serve.tokens_padded"]
+
+
+def test_ragged_utilization_beats_bucketed_on_same_requests(setup, tel):
+    """The padding win, measured: the same singleton dispatches cost
+    token_budget slots ragged vs rows×bucket slots bucketed."""
+    bucketed, ragged = setup["bucketed"], setup["ragged"]
+    text = min(setup["texts"], key=len)
+
+    def util_of(predictor):
+        registry = telemetry.configure(run_dir=None)
+        service = ScoringService(
+            predictor,
+            config=ServiceConfig(max_batch=4, max_wait_ms=1.0,
+                                 default_deadline_ms=0.0),
+        )
+        for _ in range(4):
+            InprocessClient(service).score(text)
+        service.drain()
+        counters = registry.snapshot()["counters"]
+        return counters["serve.tokens_real"] / counters["serve.tokens_padded"]
+
+    ragged_util = util_of(ragged)
+    bucketed_util = util_of(bucketed)
+    assert ragged_util > bucketed_util
+
+
+def test_serve_truncated_counts_clamped_requests(setup, tel):
+    """Over-long requests clamped into the largest bucket/budget are
+    counted (serve.truncated) instead of silently truncated; short
+    requests are not."""
+    model, params, tok = setup["model"], setup["params"], setup["tokenizer"]
+    predictor = SiamesePredictor(
+        model, params, tok, batch_size=4, max_length=16,
+        score_impl="ragged", token_budget=32, max_rows_per_pack=4,
+    )
+    predictor.encode_anchors(setup["anchors"])
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(max_batch=4, max_wait_ms=1.0,
+                             default_deadline_ms=0.0),
+    )
+    client = InprocessClient(service)
+    long_text = " ".join(
+        w for t in setup["texts"] for w in t.split()
+    )[:4000]
+    assert client.score(long_text)["status"] == "ok"
+    assert client.score("short report")["status"] == "ok"
+    service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters.get("serve.truncated", 0) == 1
+
+
+def test_report_renders_utilization_and_truncated(tmp_path):
+    """telemetry-report derives serve.real_token_utilization from the
+    padding ledger and renders serve.truncated like any counter."""
+    from memvul_tpu.telemetry.report import render_report
+
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    registry.counter("serve.tokens_real").inc(300)
+    registry.counter("serve.tokens_padded").inc(400)
+    registry.counter("serve.truncated").inc(2)
+    registry.close()
+    try:
+        text = render_report(tmp_path / "run")
+    finally:
+        telemetry.reset()
+    assert "serve.real_token_utilization = 0.750" in text
+    assert "(300/400 token slots)" in text
+    assert "serve.truncated = 2" in text
+
+
+# -- shadow scoring rides the active impl (bankops satellite) ------------------
+
+def test_shadow_scoring_is_impl_invariant(setup, tel):
+    """bankops.score_texts routes through the predictor's ACTIVE impl,
+    so a candidate bank's shadow deltas are the same whichever path is
+    live (bucketed vs ragged active service)."""
+    from memvul_tpu.bankops.shadow import ShadowScorer, score_texts
+
+    bucketed, ragged = setup["bucketed"], setup["ragged"]
+    candidate = [dict(a) for a in setup["anchors"]][: max(
+        1, len(setup["anchors"]) - 1
+    )]
+    texts = setup["texts"][:24]
+    # the scoring function the shadow worker runs, on both impls
+    bank_b, _, n_b = bucketed.encode_bank(candidate)
+    bank_r, _, n_r = ragged.encode_bank(candidate)
+    ragged.warmup_bank_shapes(bank_r)
+    rows_b = score_texts(bucketed, texts, bank_b, n_b)
+    rows_r = score_texts(ragged, texts, bank_r, n_r)
+    np.testing.assert_allclose(rows_r, rows_b, atol=1e-6, rtol=0)
+
+    # end-to-end: a shadow attached to a RAGGED service samples served
+    # traffic and scores it through the warmed ragged program with
+    # score_trace_count flat
+    service = ScoringService(
+        ragged,
+        config=ServiceConfig(max_batch=8, max_wait_ms=2.0,
+                             default_deadline_ms=30000.0),
+    )
+    shadow = ShadowScorer(service, candidate)
+    traces = ragged.score_trace_count
+    client = InprocessClient(service)
+    for text in texts:
+        assert client.score(text)["status"] == "ok"
+    deadline = 10.0
+    import time as _time
+    t0 = _time.monotonic()
+    while (
+        shadow.summary()["sampled"] < len(texts)
+        and _time.monotonic() - t0 < deadline
+    ):
+        _time.sleep(0.02)
+    summary = shadow.stop()
+    service.drain()
+    assert summary["sampled"] == len(texts)
+    assert summary["errors"] == 0
+    assert ragged.score_trace_count == traces
+
+
+# -- lint: packing stays off handler/router classes ----------------------------
+
+def test_lint_flags_ragged_dispatch_on_handler_and_router(tmp_path):
+    from lint_no_blocking_in_handler import find_blocking_calls
+
+    (tmp_path / "bad.py").write_text(
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self):\n"
+        "        packs = pack_token_budget([1], 8, 1)\n"
+        "        sample = collate_ragged([[1]], 8, 1, 0)\n"
+        "class MyRouter:\n"
+        "    def _pick(self, request):\n"
+        "        self.service.predictor._ragged_score_fn(None, None, None)\n"
+        "        return self.service.predictor.score_texts([request])\n"
+    )
+    offenders = find_blocking_calls(tmp_path)
+    names = sorted(o.rsplit(" ", 1)[-1] for o in offenders)
+    assert names == [
+        "_ragged_score_fn", "collate_ragged", "pack_token_budget",
+        "score_texts",
+    ]
+
+
+def test_serve_from_archive_ragged_end_to_end(ws, tmp_path, tel):
+    """Archive + serving.score_impl=ragged → a warmed ragged service:
+    sized from the config section, one warm program, ok responses."""
+    from memvul_tpu.archive import save_archive
+    from memvul_tpu.build import build_model, init_params, serve_from_archive
+
+    model_cfg = {
+        "type": "model_memory",
+        "encoder": {"preset": "tiny", "vocab_size": 4096},
+        "header_dim": 32,
+    }
+    config = {
+        "tokenizer": {
+            "type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"],
+        },
+        "dataset_reader": {
+            "type": "reader_memory",
+            "anchor_path": ws["paths"]["anchors"],
+            "cve_path": ws["paths"]["cve"],
+        },
+        "model": model_cfg,
+        "serving": {
+            "max_batch": 4, "max_length": 48,
+            "score_impl": "ragged", "token_budget": 96,
+        },
+    }
+    model = build_model(dict(model_cfg), 4096)
+    params = init_params(model, seed=0)
+    archive = save_archive(
+        tmp_path / "model.tar.gz", config, params,
+        tokenizer_file=ws["paths"]["tokenizer"],
+    )
+    service = serve_from_archive(archive, out_dir=tmp_path / "serve_run")
+    try:
+        assert service.predictor.score_impl == "ragged"
+        assert service.predictor.ragged_shape() == (96, 4)  # max_batch rows
+        traces = service.predictor.score_trace_count
+        response = InprocessClient(service).score("a memory safety bug")
+        assert response["status"] == "ok"
+        assert service.predictor.score_trace_count == traces  # warmed
+    finally:
+        service.drain()
+        telemetry.get_registry().close()
+
+    # a junk impl is refused with a clear error
+    with pytest.raises(ValueError, match="score_impl"):
+        serve_from_archive(
+            archive, overrides='{"serving": {"score_impl": "raggedy"}}'
+        )
+
+
+# -- bench A/B record ----------------------------------------------------------
+
+def test_serve_microbench_ab_emits_token_ledger(monkeypatch, capsys):
+    """BENCH_MICRO=serve BENCH_SERVE_IMPL=ab at tiny geometry: one
+    parseable record with both legs' real/padded token counts, ragged
+    real_token_utilization above bucketed on the same seeded skewed
+    schedule — the CPU-runnable shape of the owed on-hardware
+    datapoint."""
+    from memvul_tpu import bench
+
+    monkeypatch.setenv("BENCH_MICRO", "serve")
+    monkeypatch.setenv("BENCH_MODEL", "tiny")
+    monkeypatch.setenv("BENCH_SERVE_IMPL", "ab")
+    monkeypatch.setenv("BENCH_MICRO_REQUESTS", "48")
+    monkeypatch.setenv("BENCH_MICRO_CLIENTS", "4")
+    monkeypatch.setenv("BENCH_SERVE_MAX_BATCH", "4")
+    monkeypatch.setenv("BENCH_SEQ_LEN", "32")
+    monkeypatch.setenv("BENCH_SERVE_TOKEN_BUDGET", "32")
+    monkeypatch.setenv("BENCH_PHASE_TIMEOUT", "0")
+    bench._run_bench()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    record = json.loads(line)
+    assert record["metric"] == "serve_microbench"
+    assert record["config"]["impl_mode"] == "ab"
+    legs = record["ab"]
+    assert set(legs) == {"bucketed", "ragged"}
+    for leg in legs.values():
+        assert leg["errors"] == 0
+        assert leg["real_tokens"] > 0
+        assert leg["padded_tokens"] >= leg["real_tokens"]
+        assert 0 < leg["real_token_utilization"] <= 1
+    assert (
+        legs["ragged"]["real_token_utilization"]
+        > legs["bucketed"]["real_token_utilization"]
+    )
+    assert record["impl"] == "ragged"
+    assert record["value"] > 0

@@ -30,9 +30,14 @@ Flagged names in either family:
 * ``sleep`` (``time.sleep`` or a bare imported ``sleep``);
 * anything starting with ``predict`` (``predict_file``, ``predict_one``);
 * the scoring/encoding entry points: ``score_instances``,
-  ``encode_anchors``, ``encode_bank``, ``warmup_compile``,
-  ``warmup_bank_shapes``, ``swap_bank``, ``install_bank``, and the raw
-  jitted program ``_score_fn``.
+  ``score_texts``, ``encode_anchors``, ``encode_bank``,
+  ``warmup_compile``, ``warmup_bank_shapes``, ``swap_bank``,
+  ``install_bank``, and the raw jitted programs ``_score_fn`` /
+  ``_ragged_score_fn``;
+* the ragged serve path's packing/collation (docs/ragged_serving.md):
+  ``pack_token_budget`` and ``collate_ragged`` — packing is batcher-
+  thread work; a handler or router that packs inline serializes the
+  process exactly like inline scoring would.
 
 Usage: ``python tools/lint_no_blocking_in_handler.py [package_dir]`` —
 exits 1 listing offenders, 0 when clean, 2 on a bad argument.  Invoked
@@ -49,6 +54,7 @@ from typing import List
 FORBIDDEN_NAMES = {
     "sleep",
     "score_instances",
+    "score_texts",
     "encode_anchors",
     "encode_bank",
     "warmup_compile",
@@ -56,6 +62,9 @@ FORBIDDEN_NAMES = {
     "swap_bank",
     "install_bank",
     "_score_fn",
+    "_ragged_score_fn",
+    "pack_token_budget",
+    "collate_ragged",
 }
 FORBIDDEN_PREFIXES = ("predict",)
 
