@@ -14,6 +14,7 @@ scripts (reference: README.md:130-147).  Here everything is one CLI:
     python -m memvul_tpu bench
     python -m memvul_tpu bank build --store banks/ --anchors data/CWE_anchor_golden_project.json
     python -m memvul_tpu telemetry-report out/
+    python -m memvul_tpu lint --json
     python -m memvul_tpu doctor
     python -m memvul_tpu parity --hf-dir bert-base-uncased
     python -m memvul_tpu selfcheck
@@ -522,6 +523,17 @@ def cmd_bank_promote(args) -> int:
     return 0 if decision.approved else 1
 
 
+def cmd_lint(args) -> int:
+    """The unified static-analysis engine (docs/static_analysis.md):
+    one AST parse per file shared by every checker — bare-print,
+    handler/router blocking, artifact-write hygiene, trace purity,
+    lock discipline, and the fault/metric/config registry-drift
+    checks.  Exit 0 clean, 1 findings, 2 usage."""
+    from .analysis.cli import run_lint
+
+    return run_lint(args)
+
+
 def cmd_telemetry_report(args) -> int:
     """Render a run dir's telemetry sinks (events.jsonl / telemetry.json
     / HEARTBEAT.json) into a human summary: phase table, step-time
@@ -806,6 +818,18 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--min-shadow-samples", type=int, default=100)
     b.add_argument("--overrides", default=None)
     b.set_defaults(fn=cmd_bank_promote)
+
+    p = sub.add_parser(
+        "lint",
+        help="unified static analysis over the package: trace purity, "
+        "lock discipline, handler/artifact hygiene, and fault/metric/"
+        "config registry-drift checks — one AST parse per file, inline "
+        "suppressions + committed baseline (docs/static_analysis.md)",
+    )
+    from .analysis.cli import add_lint_arguments
+
+    add_lint_arguments(p)
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "telemetry-report",

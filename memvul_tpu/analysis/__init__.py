@@ -1,0 +1,59 @@
+"""Unified static analysis for memvul_tpu (docs/static_analysis.md).
+
+One ``ast`` parse per file shared by all registered checkers; findings
+as structured ``{code, path, line, message}`` records; inline
+``lint: disable=CODE`` comment suppressions plus a committed baseline;
+``python -m memvul_tpu lint [--select CODE,...] [--json]`` CLI.
+
+The three historical one-file lints under ``tools/`` delegate here
+(:func:`run_tool_checkers` preserves their path:line output contract);
+the new checker families (trace purity, lock discipline, registry
+drift) live in :mod:`.checkers` and need the shared multi-file context
+to be tractable at all.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, Optional
+
+from .engine import (  # noqa: F401
+    CHECKERS,
+    AnalysisResult,
+    Finding,
+    analyze,
+    baseline_document,
+    load_baseline,
+    register,
+)
+from . import checkers  # noqa: F401  (registers every checker family)
+
+PACKAGE_ROOT = Path(__file__).resolve().parents[1]
+REPO_ROOT = PACKAGE_ROOT.parent
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+
+def analyze_repo(
+    select: Optional[Iterable[str]] = None,
+    baseline_path: Optional[Path] = BASELINE_PATH,
+) -> AnalysisResult:
+    """Run the engine over the real tree with the committed baseline —
+    what the CLI, the tier-1 gate test and ``BENCH_LINT=1`` all call."""
+    return analyze(
+        PACKAGE_ROOT,
+        base_dir=REPO_ROOT,
+        docs_dir=REPO_ROOT / "docs",
+        tests_dir=REPO_ROOT / "tests",
+        select=list(select) if select is not None else None,
+        baseline=load_baseline(baseline_path),
+    )
+
+
+def run_tool_checkers(
+    codes: Iterable[str], root: Path
+) -> AnalysisResult:
+    """Engine run scoped the way the legacy ``tools/lint_*.py`` entry
+    points ran: one checker family over an arbitrary directory, paths
+    relative to that directory, no baseline."""
+    root = Path(root)
+    return analyze(root, base_dir=root, select=list(codes))

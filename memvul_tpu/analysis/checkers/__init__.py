@@ -1,0 +1,14 @@
+"""Checker families of the static-analysis engine.
+
+Importing this package registers every checker with the engine's
+registry (``engine.CHECKERS``); each module is one family:
+
+* :mod:`.prints`    — MV101 bare print (migrated tools/lint_no_bare_print)
+* :mod:`.handlers`  — MV102 blocking in handler/router classes
+* :mod:`.artifacts` — MV103 artifact-write hygiene (generalized bankops lint)
+* :mod:`.purity`    — MV201 trace purity (host effects in jitted code)
+* :mod:`.locks`     — MV301/302/303 lock discipline in threaded classes
+* :mod:`.drift`     — MV401–404 registry drift (faults / metrics / config)
+"""
+
+from . import artifacts, drift, handlers, locks, prints, purity  # noqa: F401

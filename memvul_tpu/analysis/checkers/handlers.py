@@ -1,0 +1,95 @@
+"""MV102 — handler threads only enqueue + wait; routers only select.
+
+Migrated from ``tools/lint_no_blocking_in_handler.py`` (now a
+delegating shim).  Two class families, wherever they live:
+
+* classes with a base whose name ends with ``RequestHandler`` — one
+  thread per connection; anything blocking serializes the whole server
+  behind one client and can trigger the mid-serve XLA compiles the
+  micro-batcher exists to prevent (docs/serving.md);
+* classes named ``*Router`` (or deriving from one) — a routing decision
+  reads queue depths and picks a replica, nothing more; heavy fleet
+  operations belong to control-plane workers.
+
+The forbidden-name set is the serving tier's scoring/encoding/packing
+surface plus ``sleep``; ``predict*`` is banned by prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..engine import AnalysisContext, Finding, called_name, register
+
+CODE = "MV102"
+
+FORBIDDEN_NAMES = {
+    "sleep",
+    "score_instances",
+    "score_texts",
+    "encode_anchors",
+    "encode_bank",
+    "warmup_compile",
+    "warmup_bank_shapes",
+    "swap_bank",
+    "install_bank",
+    "_score_fn",
+    "_ragged_score_fn",
+    # the ragged serve path's packing/collation (docs/ragged_serving.md):
+    # packing is batcher-thread work; a handler or router that packs
+    # inline serializes the process exactly like inline scoring would
+    "pack_token_budget",
+    "collate_ragged",
+}
+FORBIDDEN_PREFIXES = ("predict",)
+
+
+def _base_name(base: ast.expr) -> str:
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    if isinstance(base, ast.Name):
+        return base.id
+    return ""
+
+
+def _is_handler_class(node: ast.ClassDef) -> bool:
+    return any(
+        _base_name(b).endswith("RequestHandler") for b in node.bases
+    )
+
+
+def _is_router_class(node: ast.ClassDef) -> bool:
+    if node.name.endswith("Router"):
+        return True
+    return any(_base_name(b).endswith("Router") for b in node.bases)
+
+
+@register(
+    CODE,
+    "blocking-in-handler",
+    "blocking call in an HTTP handler or router dispatch class",
+)
+def check(ctx: AnalysisContext) -> Iterator[Finding]:
+    for pf in ctx.files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if not (
+                isinstance(node, ast.ClassDef)
+                and (_is_handler_class(node) or _is_router_class(node))
+            ):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                name = called_name(call)
+                if name in FORBIDDEN_NAMES or name.startswith(FORBIDDEN_PREFIXES):
+                    yield Finding(
+                        CODE, pf.rel, call.lineno,
+                        f"blocking call {name}() inside {node.name} — a "
+                        "handler may only submit() and wait on the future; "
+                        "a router may only select a replica queue "
+                        "(docs/serving.md)",
+                        symbol=name,
+                    )

@@ -1,0 +1,240 @@
+"""MV201 — no host side effects inside traced (jit/Pallas/scan) code.
+
+Keeping the hot path free of implicit host syncs and Python side
+effects is exactly what the accelerator roofline demands: a ``print``
+or ``time.time()`` inside a jitted function runs at *trace* time
+(silently, once — usually a bug's symptom, not its absence), while
+``.item()`` / ``np.asarray`` / ``jax.device_get`` on a traced value
+forces a device→host sync that stalls the pipeline every step.
+
+The checker builds an intra-package call-graph approximation:
+
+* **roots** — functions passed (by name) to ``jax.jit`` / ``pjit`` /
+  ``pl.pallas_call`` / ``checkify`` / ``nn.scan`` / ``lax.scan`` /
+  ``remat``, functions *decorated* with jit/pjit, and methods of
+  ``nn.Module`` subclasses (flax modules are traced by construction);
+* **edges** — call sites resolved by terminal name against the
+  function-def index of the scoped files (``models/``, ``ops/``,
+  ``training/``, ``evaluate/`` in package mode — the model stack, the
+  kernels, and the trainer/predictor step fns).
+
+Inside reachable functions (own body only — nested defs are reached
+via edges) it flags: ``print``, ``time.*``, ``random.*`` /
+``np.random.*``, ``.item()``, ``jax.device_get`` / ``np.asarray``,
+telemetry emission chains (``...counter(...).inc()`` etc. and
+registry ``event``/``span``/``heartbeat`` calls), and ``float()`` /
+``int()`` applied directly to a parameter of the traced function.
+
+Intentional trace-time effects (the ``score_trace_count`` probe's
+cousin — e.g. the fused-kernel degradation counter that ticks once at
+trace) carry inline ``lint: disable=MV201`` justifications.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..engine import AnalysisContext, Finding, ParsedFile, called_name, register
+
+CODE = "MV201"
+
+SCOPED_DIRS = ("models", "ops", "training", "evaluate")
+
+# call wrappers whose function-valued arguments are traced
+JIT_WRAPPERS = {
+    "jit", "pjit", "pallas_call", "checkify", "scan", "remat", "named_call",
+}
+_TELEMETRY_CHAIN = {"counter", "gauge", "histogram"}
+_TELEMETRY_TERMINALS = {"inc", "observe", "set"}
+_REGISTRY_CALLS = {"event", "span", "heartbeat", "progress"}
+
+FuncDef = Tuple[ParsedFile, ast.FunctionDef]
+
+
+def _is_module_class(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = base.attr if isinstance(base, ast.Attribute) else (
+            base.id if isinstance(base, ast.Name) else ""
+        )
+        if name.endswith("Module"):
+            return True
+    return False
+
+
+def _own_body_nodes(fn: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Walk a function's body without descending into nested function/
+    class definitions (those are separate graph nodes)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _receiver_is_registry(func: ast.Attribute) -> bool:
+    """``tel.event(...)`` / ``get_registry().span(...)`` — the receiver
+    chain names a telemetry registry."""
+    value = func.value
+    if isinstance(value, ast.Call) and called_name(value) == "get_registry":
+        return True
+    name = ""
+    if isinstance(value, ast.Name):
+        name = value.id
+    elif isinstance(value, ast.Attribute):
+        name = value.attr
+    return name.lstrip("_") in {"tel", "telemetry", "registry"}
+
+
+def _host_effect(node: ast.AST, params: Set[str]) -> Optional[Tuple[str, str]]:
+    """(symbol, description) when ``node`` is a host side effect."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    name = called_name(node)
+    if isinstance(func, ast.Name):
+        if name == "print":
+            return "print", "print() call"
+        if name in ("float", "int") and len(node.args) == 1:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in params:
+                return name, (
+                    f"{name}() on traced argument {arg.id!r} "
+                    "(forces a device→host sync)"
+                )
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    value = func.value
+    if isinstance(value, ast.Name):
+        if value.id == "time":
+            return f"time.{name}", f"time.{name}() host clock call"
+        if value.id == "random":
+            return f"random.{name}", f"random.{name}() host RNG call"
+        if value.id in ("np", "numpy") and name in ("asarray", "random"):
+            return f"np.{name}", f"np.{name}() materializes on host"
+        if value.id == "jax" and name == "device_get":
+            return "jax.device_get", "jax.device_get() device→host sync"
+    if (
+        isinstance(value, ast.Attribute)
+        and value.attr == "random"
+        and isinstance(value.value, ast.Name)
+        and value.value.id in ("np", "numpy")
+    ):
+        return f"np.random.{name}", f"np.random.{name}() host RNG call"
+    if name == "item" and not node.args:
+        return ".item", ".item() device→host sync"
+    if name in _TELEMETRY_TERMINALS and isinstance(value, ast.Call):
+        if called_name(value) in _TELEMETRY_CHAIN:
+            chain = called_name(value)
+            return (
+                f"{chain}().{name}",
+                f"telemetry {chain}().{name}() emission",
+            )
+    if name in _REGISTRY_CALLS and _receiver_is_registry(func):
+        return f"registry.{name}", f"telemetry registry {name}() call"
+    return None
+
+
+def _collect_defs(files: List[ParsedFile]) -> Dict[str, List[FuncDef]]:
+    index: Dict[str, List[FuncDef]] = {}
+    for pf in files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                index.setdefault(node.name, []).append((pf, node))
+    return index
+
+
+def _collect_roots(
+    files: List[ParsedFile], index: Dict[str, List[FuncDef]]
+) -> Set[str]:
+    roots: Set[str] = set()
+    for pf in files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    target = deco.func if isinstance(deco, ast.Call) else deco
+                    dname = (
+                        target.attr if isinstance(target, ast.Attribute)
+                        else target.id if isinstance(target, ast.Name) else ""
+                    )
+                    if dname in ("jit", "pjit"):
+                        roots.add(node.name)
+            elif isinstance(node, ast.ClassDef) and _is_module_class(node):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        roots.add(item.name)
+            elif isinstance(node, ast.Call) and called_name(node) in JIT_WRAPPERS:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id in index:
+                            roots.add(sub.id)
+    return roots
+
+
+def _edges(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in _own_body_nodes(fn):
+        if isinstance(node, ast.Call):
+            name = called_name(node)
+            if name:
+                out.add(name)
+        # nested defs are graph nodes of their own, reached when called;
+        # a nested def *defined and returned* is reached via the jit
+        # wrapper that captures it (root collection walks every Call)
+    return out
+
+
+@register(
+    CODE,
+    "trace-impure",
+    "host side effect inside code reachable from a jitted/Pallas entry",
+)
+def check(ctx: AnalysisContext) -> Iterator[Finding]:
+    files = [
+        pf for pf in ctx.files if ctx.in_dirs(pf, SCOPED_DIRS)
+    ]
+    index = _collect_defs(files)
+    roots = _collect_roots(files, index)
+    reachable: Set[str] = set()
+    frontier = [r for r in roots if r in index]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for _, fn in index[name]:
+            for callee in _edges(fn):
+                if callee in index and callee not in reachable:
+                    frontier.append(callee)
+    seen: Set[Tuple[str, int, str]] = set()
+    for name in sorted(reachable):
+        for pf, fn in index[name]:
+            params = {
+                a.arg for a in (
+                    fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+                )
+                if a.arg not in ("self", "cls")
+            }
+            for node in _own_body_nodes(fn):
+                effect = _host_effect(node, params)
+                if effect is None:
+                    continue
+                symbol, desc = effect
+                key = (pf.rel, node.lineno, symbol)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    CODE, pf.rel, node.lineno,
+                    f"host side effect in traced code: {desc} inside "
+                    f"{name}() (reachable from a jit/Pallas/nn.Module "
+                    "entry) — hoist it out of the traced region",
+                    symbol=symbol,
+                )

@@ -54,7 +54,10 @@ slow backend, cf. BENCH_r05) and exits 124 fast instead of sitting
 silent until the external ``timeout`` kill; the supervisor retries it),
 BENCH_TELEMETRY_DIR (write a telemetry run dir — phase spans in
 events.jsonl, HEARTBEAT.json liveness, telemetry.json rollup — readable
-via ``python -m memvul_tpu telemetry-report``; docs/observability.md).
+via ``python -m memvul_tpu telemetry-report``; docs/observability.md),
+BENCH_LINT=1 (the supervisor first prints one ``{"metric": "lint"}``
+JSON record from the static-analysis engine — docs/static_analysis.md —
+so a sweep collects code-health alongside throughput).
 
 Supervision. The TPU backend behind the axon tunnel can be transiently
 UNAVAILABLE (it was at the round-2 snapshot, which lost the headline
@@ -1126,6 +1129,26 @@ def _supervise(cmd, attempts: int, attempt_timeout: float, backoff: float, env=N
     return None, last_error
 
 
+def _lint_record() -> dict:
+    """BENCH_LINT=1: run the static-analysis engine over the tree and
+    return one parseable JSON record (docs/static_analysis.md).  The
+    supervisor prints it as its own line BEFORE the bench result, so a
+    sweep harness can collect code-health alongside throughput without
+    a second process."""
+    from memvul_tpu.analysis import analyze_repo
+
+    result = analyze_repo()
+    return {
+        "metric": "lint",
+        "clean": not result.active,
+        "findings": [f.to_json() for f in result.active],
+        "suppressed": len(result.suppressed),
+        "baselined": len(result.baselined),
+        "files": result.parse_count,
+        "elapsed_s": round(result.elapsed_s, 3),
+    }
+
+
 def main() -> int:
     if os.environ.get(_CHILD_ENV_FLAG) == "1":
         # BENCH_TELEMETRY_DIR=<dir>: the child keeps a full telemetry run
@@ -1145,6 +1168,12 @@ def main() -> int:
 
                 get_registry().close()
         return 0
+
+    if os.environ.get("BENCH_LINT") == "1":
+        # surfaced by the supervisor (one JSON line of its own) so the
+        # record rides the same stdout contract as the bench result
+        print(json.dumps(_lint_record()))
+        sys.stdout.flush()
 
     attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", "3")))
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1500"))

@@ -59,6 +59,24 @@ from typing import Dict, List, Optional
 
 _ENV_VAR = "MEMVUL_FAULTS"
 
+# Machine-readable registry of the injection points in the table above.
+# The static-analysis engine (docs/static_analysis.md, checker MV401)
+# reconciles every ``fault_point(...)`` call site and every point named
+# in a test/doc MEMVUL_FAULTS spec against this set — a typo'd chaos
+# spec otherwise arms nothing and silently tests nothing.  Dynamic
+# families (``step.<n>``, ``replica.kill.<name>``) register their
+# prefix in REGISTERED_POINT_PREFIXES.
+REGISTERED_POINTS = frozenset({
+    "data.read",
+    "ckpt.write",
+    "score.batch",
+    "serve.batch",
+    "replica.kill",
+    "bank.shadow",
+    "kernel.lower",
+})
+REGISTERED_POINT_PREFIXES = ("step.", "replica.kill.")
+
 _lock = threading.Lock()
 _faults: Dict[str, List["_Fault"]] = {}
 _armed = False  # fast-path gate: fault_point returns immediately when False

@@ -467,7 +467,10 @@ class ScoringService:
         rollout, "promotion"/"demotion" for the bankops gate
         (docs/anchor_bank.md)."""
         with self._swap_lock:
-            bank, labels, n_anchors = self.predictor.encode_bank(
+            # the swap lock is control-plane-only (serializes concurrent
+            # swaps); the request path never takes it, so encoding and
+            # warming under it is deliberate, not a batcher stall
+            bank, labels, n_anchors = self.predictor.encode_bank(  # lint: disable=MV301
                 anchor_instances
             )
             with self._bank_lock:
@@ -483,7 +486,9 @@ class ScoringService:
                     len(self._rows_by_length),
                 )
                 with self._tel.span("serve.bank_warmup"):
-                    self.predictor.warmup_bank_shapes(bank)
+                    # same contract as the encode above: control-plane
+                    # lock, never contended by the request path
+                    self.predictor.warmup_bank_shapes(bank)  # lint: disable=MV301
             with self._bank_lock:
                 new = _BankVersion(
                     version=current.version + 1 if version is None

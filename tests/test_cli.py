@@ -702,7 +702,7 @@ def test_cli_help_names_every_registered_subcommand(capsys):
     assert {
         "train", "evaluate", "serve", "pretrain", "baseline", "build-data",
         "analyze", "bench", "bank", "telemetry-report", "doctor", "parity",
-        "selfcheck",
+        "selfcheck", "lint",
     } <= names
     # every subcommand carries a non-empty one-line help
     helps = {ca.dest: ca.help for ca in sub._choices_actions}
@@ -724,6 +724,18 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         for flag in action.option_strings
     }
     assert {"--replicas", "--out-dir", "--overrides", "--port"} <= serve_flags
+    # the lint subcommand's flag surface is pinned too: the engine's
+    # select/json/baseline workflow (docs/static_analysis.md) must stay
+    # registered
+    lint_flags = {
+        flag
+        for action in sub.choices["lint"]._actions
+        for flag in action.option_strings
+    }
+    assert {
+        "--select", "--json", "--baseline", "--no-baseline",
+        "--write-baseline", "--list-codes",
+    } <= lint_flags
 
 
 def test_cli_bank_help_names_every_lifecycle_subcommand(capsys):

@@ -1,81 +1,58 @@
 #!/usr/bin/env python
-"""Lint: ``bankops/`` may write artifacts only through the committed
-helpers — ``resilience.io.atomic_write_text`` (whole-document commits)
-or the telemetry ``JsonlSink`` (append-only trails).
+"""Lint: durable subsystems may write artifacts only through the
+committed helpers — ``resilience.io.atomic_write_text`` (whole-document
+commits) or the telemetry ``JsonlSink`` (append-only trails).
 
-A bank version is an *immutable, digest-verified* artifact
-(docs/anchor_bank.md): a bare ``open(..., "w")`` or
-``Path.write_text`` in the lifecycle subsystem is a torn-write hazard
-— a kill mid-write would leave half an anchor set or half a manifest
-where a promotion gate expects a committed version.  This AST check
-flags, anywhere under the target dir (default
-``memvul_tpu/bankops/``):
+Thin shim over the shared static-analysis engine
+(``memvul_tpu/analysis/``, checker **MV103** — docs/static_analysis.md),
+which generalizes this check beyond ``bankops/`` to ``serving/``,
+``resilience/`` and ``telemetry/`` when run over the whole package.
+This entry point preserves the historical CLI contract and the
+``find_bare_writes`` helper the tier-1 tests import; its default target
+stays ``memvul_tpu/bankops/``.
 
-* ``open(...)`` calls whose mode (2nd positional or ``mode=`` keyword)
-  contains any of ``w``/``a``/``x``/``+`` — read-only opens are fine;
-* ``.write_text(...)`` / ``.write_bytes(...)`` attribute calls (the
-  ``Path`` direct-write API).
+Flagged (see ``memvul_tpu/analysis/checkers/artifacts.py``):
+
+* ``open(...)`` whose mode contains any of ``w``/``a``/``x``/``+``
+  (dynamic modes are flagged too — artifact writes must be static);
+* ``.write_text(...)`` / ``.write_bytes(...)`` attribute calls.
 
 Usage: ``python tools/lint_bank_artifact_writes.py [dir]`` — exits 1
-listing offenders, 0 when clean, 2 on a bad argument.  Invoked as a
-tier-1 test from ``tests/test_bankops.py``.
+listing offenders as 1-based ``path:line``, 0 when clean, 2 on a bad
+argument.  Invoked as a tier-1 test from ``tests/test_bankops.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 from typing import List
 
-WRITE_MODE_CHARS = set("wax+")
-FORBIDDEN_ATTRS = {"write_text", "write_bytes"}
-
-
-def _open_write_mode(node: ast.Call) -> bool:
-    """True when this is an ``open(...)`` call with a writing mode."""
-    func = node.func
-    name = func.id if isinstance(func, ast.Name) else (
-        func.attr if isinstance(func, ast.Attribute) else ""
-    )
-    if name != "open":
-        return False
-    mode = None
-    if len(node.args) >= 2:
-        mode = node.args[1]
-    for kw in node.keywords:
-        if kw.arg == "mode":
-            mode = kw.value
-    if mode is None:
-        return False  # default "r"
-    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
-        return bool(set(mode.value) & WRITE_MODE_CHARS)
-    return True  # dynamic mode: flag it — artifact writes must be static
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
 
 def find_bare_writes(root: Path) -> List[str]:
-    """``path:line`` offender list for every direct artifact write."""
-    offenders: List[str] = []
-    for path in sorted(root.rglob("*.py")):
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            if _open_write_mode(node):
-                offenders.append(f"{path}:{node.lineno}")
-            elif (
-                isinstance(node.func, ast.Attribute)
-                and node.func.attr in FORBIDDEN_ATTRS
-            ):
-                offenders.append(f"{path}:{node.lineno}")
-    return offenders
+    """``path:line`` offender list for every direct artifact write
+    under ``root``, via the shared engine's MV103 checker."""
+    from memvul_tpu.analysis import run_tool_checkers
+
+    root = Path(root)
+    result = run_tool_checkers(["MV001", "MV103"], root)
+    out: List[str] = []
+    for f in result.active:
+        path = root / f.path
+        if f.code == "MV001":
+            out.append(f"{path}:{f.line}: {f.message}")
+        else:
+            out.append(f"{path}:{f.line}")
+    return out
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = Path(argv[0]) if argv else (
-        Path(__file__).resolve().parent.parent / "memvul_tpu" / "bankops"
-    )
+    root = Path(argv[0]) if argv else (_REPO / "memvul_tpu" / "bankops")
     if not root.is_dir():
         print(f"not a directory: {root}", file=sys.stderr)
         return 2

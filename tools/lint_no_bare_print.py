@@ -1,54 +1,46 @@
 #!/usr/bin/env python
 """Lint: no bare ``print(`` calls in ``memvul_tpu/`` library code.
 
-Library output must go through ``logging`` (operator-facing messages)
-or the telemetry registry (machine-facing run data,
-docs/observability.md) — a bare print from deep inside a scoring stream
-corrupts the one-JSON-line stdout contract of the bench/CLI entry
-points and is invisible to telemetry-report.  The two intentional
-stdout writers are exempt: ``bench.py`` (its stdout IS the result
-contract) and ``__main__.py`` (the CLI's user-facing output).
-
-The check is AST-based, so ``print`` inside string literals (e.g. the
-doctor's subprocess probe source, utils/doctor.py) is not flagged —
-those strings execute in a child whose stdout is the parsed protocol.
+Thin shim over the shared static-analysis engine
+(``memvul_tpu/analysis/``, checker **MV101** — docs/static_analysis.md):
+the engine owns the single AST walk; this entry point only preserves
+the historical CLI contract and the ``find_bare_prints`` helper the
+tier-1 tests import.  Library output must go through ``logging`` or the
+telemetry registry (docs/observability.md); ``bench.py`` and
+``__main__.py`` are exempt by filename (their stdout IS the contract).
 
 Usage: ``python tools/lint_no_bare_print.py [package_dir]`` — exits 1
-listing offenders, 0 when clean.  Invoked as a tier-1 test from
-``tests/test_no_bare_print.py``.
+listing offenders as 1-based ``path:line``, 0 when clean, 2 on a bad
+argument.  Invoked as a tier-1 test from ``tests/test_no_bare_print.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 from typing import List
 
-# files whose stdout is an intentional, documented contract
-ALLOWED_FILES = {"bench.py", "__main__.py"}
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
 
 def find_bare_prints(package_dir: Path) -> List[str]:
     """``path:line`` for every ``print(...)`` call expression under
-    ``package_dir``, excluding :data:`ALLOWED_FILES`."""
-    offenders: List[str] = []
-    for path in sorted(package_dir.rglob("*.py")):
-        if path.name in ALLOWED_FILES:
-            continue
-        try:
-            tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-        except SyntaxError as e:  # a file that doesn't parse is its own bug
-            offenders.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
-            continue
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"
-            ):
-                offenders.append(f"{path}:{node.lineno}")
-    return offenders
+    ``package_dir`` (plus ``path:line: syntax error: ...`` for files
+    that do not parse), via the shared engine's MV101 checker."""
+    from memvul_tpu.analysis import run_tool_checkers
+
+    package_dir = Path(package_dir)
+    result = run_tool_checkers(["MV001", "MV101"], package_dir)
+    out: List[str] = []
+    for f in result.active:
+        path = package_dir / f.path
+        if f.code == "MV001":
+            out.append(f"{path}:{f.line}: {f.message}")
+        else:
+            out.append(f"{path}:{f.line}")
+    return out
 
 
 def main(argv: List[str] | None = None) -> int:
@@ -56,7 +48,7 @@ def main(argv: List[str] | None = None) -> int:
     if argv:
         package_dir = Path(argv[0])
     else:
-        package_dir = Path(__file__).resolve().parent.parent / "memvul_tpu"
+        package_dir = _REPO / "memvul_tpu"
     if not package_dir.is_dir():
         print(f"lint_no_bare_print: {package_dir} is not a directory",
               file=sys.stderr)

@@ -2,133 +2,49 @@
 """Lint: HTTP handler threads may only enqueue + wait on a future, and
 router dispatch classes may only select a replica queue.
 
-The serving front end (memvul_tpu/serving/frontend.py) runs one thread
-per connection.  A handler that calls ``time.sleep`` or any scoring/
-encoding entry point inline serializes the whole server behind one
-connection and — worse — can trigger the mid-serve XLA compiles the
-micro-batcher exists to prevent (docs/serving.md).  The allowed surface
-is exactly: ``service.submit(...)`` and ``future.result(...)``.
-
-The replica router (memvul_tpu/serving/router.py) lives under the same
-discipline one layer down: a *routing decision* reads queue depths and
-picks a replica — it may never encode, score, warm, swap, or sleep
-inline, because every request in the process is behind it.  Heavy fleet
-operations (restart rebuilds, bank installs) belong to Replica methods
-invoked from control-plane code (the monitor's worker threads, the
-module-level ``rolling_swap``), not to the router class body.
-
-The check is AST-based, over two class families wherever they live
-under the package dir:
-
-* classes whose *base* name ends with ``RequestHandler`` (stdlib
-  ``BaseHTTPRequestHandler`` or a subclass) — handler threads;
-* classes whose own or base name ends with ``Router`` — dispatch
-  classes.
-
-Flagged names in either family:
-
-* ``sleep`` (``time.sleep`` or a bare imported ``sleep``);
-* anything starting with ``predict`` (``predict_file``, ``predict_one``);
-* the scoring/encoding entry points: ``score_instances``,
-  ``score_texts``, ``encode_anchors``, ``encode_bank``,
-  ``warmup_compile``, ``warmup_bank_shapes``, ``swap_bank``,
-  ``install_bank``, and the raw jitted programs ``_score_fn`` /
-  ``_ragged_score_fn``;
-* the ragged serve path's packing/collation (docs/ragged_serving.md):
-  ``pack_token_budget`` and ``collate_ragged`` — packing is batcher-
-  thread work; a handler or router that packs inline serializes the
-  process exactly like inline scoring would.
+Thin shim over the shared static-analysis engine
+(``memvul_tpu/analysis/``, checker **MV102** — docs/static_analysis.md):
+the engine owns the single AST walk and the forbidden-name set (the
+serving tier's scoring/encoding/packing surface plus ``sleep``; see
+``memvul_tpu/analysis/checkers/handlers.py``); this entry point only
+preserves the historical CLI contract and the ``find_blocking_calls``
+helper the tier-1 tests import.  Rationale lives in docs/serving.md: a
+handler that scores inline serializes the server behind one connection;
+a router that does it stalls every request in the process.
 
 Usage: ``python tools/lint_no_blocking_in_handler.py [package_dir]`` —
-exits 1 listing offenders, 0 when clean, 2 on a bad argument.  Invoked
-as a tier-1 test from ``tests/test_no_blocking_in_handler.py``.
+exits 1 listing offenders as 1-based ``path:line: name``, 0 when clean,
+2 on a bad argument.  Invoked as a tier-1 test from
+``tests/test_no_blocking_in_handler.py``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 from typing import List
 
-FORBIDDEN_NAMES = {
-    "sleep",
-    "score_instances",
-    "score_texts",
-    "encode_anchors",
-    "encode_bank",
-    "warmup_compile",
-    "warmup_bank_shapes",
-    "swap_bank",
-    "install_bank",
-    "_score_fn",
-    "_ragged_score_fn",
-    "pack_token_budget",
-    "collate_ragged",
-}
-FORBIDDEN_PREFIXES = ("predict",)
-
-
-def _called_name(node: ast.Call) -> str:
-    """The terminal name of a call: ``time.sleep(...)`` → "sleep",
-    ``service.predictor.predict_file(...)`` → "predict_file"."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
-
-def _is_handler_class(node: ast.ClassDef) -> bool:
-    for base in node.bases:
-        name = base.attr if isinstance(base, ast.Attribute) else (
-            base.id if isinstance(base, ast.Name) else ""
-        )
-        if name.endswith("RequestHandler"):
-            return True
-    return False
-
-
-def _is_router_class(node: ast.ClassDef) -> bool:
-    """A router dispatch class: named ``*Router`` or deriving from one
-    (the serving tier's ``ReplicaRouter`` and anything that subclasses
-    it to customize the routing policy)."""
-    if node.name.endswith("Router"):
-        return True
-    for base in node.bases:
-        name = base.attr if isinstance(base, ast.Attribute) else (
-            base.id if isinstance(base, ast.Name) else ""
-        )
-        if name.endswith("Router"):
-            return True
-    return False
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
 
 def find_blocking_calls(package_dir: Path) -> List[str]:
     """``path:line: name`` for every forbidden call inside a
     ``*RequestHandler`` subclass or a ``*Router`` dispatch class under
-    ``package_dir``."""
-    offenders: List[str] = []
-    for path in sorted(package_dir.rglob("*.py")):
-        try:
-            tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-        except SyntaxError as e:  # a file that doesn't parse is its own bug
-            offenders.append(f"{path}:{e.lineno}: syntax error: {e.msg}")
-            continue
-        for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.ClassDef)
-                and (_is_handler_class(node) or _is_router_class(node))
-            ):
-                continue
-            for call in ast.walk(node):
-                if not isinstance(call, ast.Call):
-                    continue
-                name = _called_name(call)
-                if name in FORBIDDEN_NAMES or name.startswith(FORBIDDEN_PREFIXES):
-                    offenders.append(f"{path}:{call.lineno}: {name}")
-    return offenders
+    ``package_dir``, via the shared engine's MV102 checker."""
+    from memvul_tpu.analysis import run_tool_checkers
+
+    package_dir = Path(package_dir)
+    result = run_tool_checkers(["MV001", "MV102"], package_dir)
+    out: List[str] = []
+    for f in result.active:
+        path = package_dir / f.path
+        if f.code == "MV001":
+            out.append(f"{path}:{f.line}: {f.message}")
+        else:
+            out.append(f"{path}:{f.line}: {f.symbol}")
+    return out
 
 
 def main(argv: List[str] | None = None) -> int:
@@ -136,7 +52,7 @@ def main(argv: List[str] | None = None) -> int:
     if argv:
         package_dir = Path(argv[0])
     else:
-        package_dir = Path(__file__).resolve().parent.parent / "memvul_tpu"
+        package_dir = _REPO / "memvul_tpu"
     if not package_dir.is_dir():
         print(f"lint_no_blocking_in_handler: {package_dir} is not a directory",
               file=sys.stderr)
