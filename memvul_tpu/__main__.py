@@ -322,7 +322,11 @@ def cmd_serve(args) -> int:
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
         return 2
-    server = run_http_server(service, host=args.host, port=args.port)
+    # the run dir doubles as the /profilez capture root: on-demand
+    # jax.profiler traces land beside the telemetry sinks
+    server = run_http_server(
+        service, host=args.host, port=args.port, profile_dir=args.out_dir
+    )
     stop = threading.Event()
     previous = []
 
@@ -344,9 +348,10 @@ def cmd_serve(args) -> int:
             stop.wait(0.5)
     finally:
         server.shutdown()
-        monitor = getattr(service, "drift_monitor", None)
-        if monitor is not None:
-            monitor.stop()
+        for attr in ("drift_monitor", "slo_monitor"):
+            monitor = getattr(service, attr, None)
+            if monitor is not None:
+                monitor.stop()
         service.drain()
         for sig, handler in previous:
             _signal.signal(sig, handler)
@@ -537,14 +542,20 @@ def cmd_lint(args) -> int:
 def cmd_telemetry_report(args) -> int:
     """Render a run dir's telemetry sinks (events.jsonl / telemetry.json
     / HEARTBEAT.json) into a human summary: phase table, step-time
-    percentiles, counter totals, last-heartbeat age."""
-    from .telemetry.report import render_report
+    percentiles, counter totals, last-heartbeat age.  ``--json`` emits
+    the machine-readable report instead (schema pinned in tests, the
+    ``lint --json`` pattern) so bench/CI consume run summaries without
+    scraping table text."""
+    from .telemetry.report import render_report, report_json
 
     run_dir = Path(args.run_dir)
     if not run_dir.is_dir():
         print(f"telemetry-report: {run_dir} is not a directory", file=sys.stderr)
         return 2
-    print(render_report(run_dir))
+    if args.json:
+        print(json.dumps(report_json(run_dir), indent=2, default=str))
+    else:
+        print(render_report(run_dir))
     return 0
 
 
@@ -702,9 +713,10 @@ def build_parser() -> argparse.ArgumentParser:
         "serve",
         help="online scoring service over an archived model: micro-"
         "batched, AOT-warmed, stdlib HTTP front end (POST /score, GET "
-        "/healthz), graceful SIGTERM drain; --replicas N runs a health-"
-        "gated multi-replica router, one service per local device "
-        "(docs/serving.md)",
+        "/healthz, GET /metrics Prometheus scrape, GET /tracez request "
+        "traces, POST /profilez on-demand profiler capture), graceful "
+        "SIGTERM drain; --replicas N runs a health-gated multi-replica "
+        "router, one service per local device (docs/serving.md)",
     )
     p.add_argument("archive", help="model.tar.gz or its serialization dir")
     p.add_argument("-o", "--out-dir", default=None,
@@ -835,9 +847,13 @@ def build_parser() -> argparse.ArgumentParser:
         "telemetry-report",
         help="render a run dir's telemetry (events.jsonl / telemetry.json "
         "/ HEARTBEAT.json) into a human summary: phases, step-time "
-        "percentiles, counters, last-heartbeat age",
+        "percentiles, counters, last-heartbeat age; --json for the "
+        "machine-readable report",
     )
     p.add_argument("run_dir", help="serialization/output dir of a run")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable report (stable schema "
+                   "— the lint --json pattern) instead of the table text")
     p.set_defaults(fn=cmd_telemetry_report)
 
     p = sub.add_parser(

@@ -12,7 +12,15 @@ delegating shim).  Two class families, wherever they live:
   operations belong to control-plane workers.
 
 The forbidden-name set is the serving tier's scoring/encoding/packing
-surface plus ``sleep``; ``predict*`` is banned by prefix.
+surface plus ``sleep`` and the fleet control-plane entry points
+(``swap_bank``/``install_bank``/``rolling_swap``); ``predict*`` is
+banned by prefix.  The observability endpoints (``/metrics``,
+``/tracez``, ``/profilez``; serving/frontend.py) live under the same
+rule: they may only read *snapshots* — registry snapshots, the trace
+ring, a monitor's ``status()`` — so a scrape can never stall the
+batcher or trigger a compile (the known-bad fixtures in
+tests/test_static_analysis.py pin that a handler calling ``predict*``
+or ``pack_token_budget`` fails tier-1).
 """
 
 from __future__ import annotations
@@ -41,6 +49,10 @@ FORBIDDEN_NAMES = {
     # inline serializes the process exactly like inline scoring would
     "pack_token_budget",
     "collate_ragged",
+    # fleet rollouts are control-plane work (drain + encode + warm per
+    # replica); an endpoint that triggers one inline would wedge every
+    # connection behind the rollout
+    "rolling_swap",
 }
 FORBIDDEN_PREFIXES = ("predict",)
 

@@ -899,10 +899,19 @@ def _run_serve_router_micro(
                     telemetry_enabled=True)
             for i in range(n_replicas)
         ]
+    router_registry = TelemetryRegistry(enabled=True)
     router = ReplicaRouter(
-        replicas, config=RouterConfig(),
-        registry=TelemetryRegistry(enabled=True),
+        replicas, config=RouterConfig(), registry=router_registry,
     )
+    # the SLO evaluator (serving/slo.py): its availability/burn-rate/
+    # scale_hint block rides the harness record (the harness ticks it)
+    from memvul_tpu.serving.slo import SLOConfig, SLOMonitor
+
+    router.slo_monitor = SLOMonitor(
+        router, registry=router_registry,
+        config=SLOConfig(interval_s=1.0), start=False,
+    )
+    router.slo_monitor.tick()  # the pre-load baseline sample
     load = LoadConfig(
         pattern=pattern, requests=n_requests, clients=n_clients, rps=rps,
         deadline_ms=None if pattern != "slowloris" else 60_000.0,
@@ -942,6 +951,7 @@ def _run_serve_router_micro(
                     ],
                 },
                 "router": record.get("router", {}),
+                "slo": record.get("slo", {}),
                 "config": {
                     "model": os.environ.get("BENCH_MODEL", "base"),
                     "seq_len": seq_len,

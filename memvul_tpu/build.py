@@ -419,16 +419,48 @@ def serve_from_archive(
     retries = int(serve_cfg["retries"])
     retry_policy = RetryPolicy(attempts=retries) if retries > 0 else None
     bank_cfg = bankops_config(arch.config)
+    trace_sample_rate = float(serve_cfg["trace_sample_rate"])
+    if not 0.0 <= trace_sample_rate <= 1.0:
+        raise ValueError(
+            "serving.trace_sample_rate must be in [0, 1], got "
+            f"{trace_sample_rate!r}"
+        )
     service_config = ServiceConfig(
         max_batch=int(serve_cfg["max_batch"]),
         max_wait_ms=float(serve_cfg["max_wait_ms"]),
         max_queue=int(serve_cfg["max_queue"]),
         default_deadline_ms=float(serve_cfg["default_deadline_ms"]),
         anchor_stats=bool(bank_cfg["anchor_stats"]),
+        trace_sample_rate=trace_sample_rate,
+        trace_ring=int(serve_cfg["trace_ring"]),
+        hbm_gauges=bool(tel_cfg["hbm_gauges"]),
     )
     n_replicas = int(
         serve_cfg["replicas"] if replicas is None else replicas
     )
+
+    def _with_slo_monitor(target):
+        # the live SLO evaluator (serving/slo.py): slo.* gauges, the
+        # /healthz slo block, and the scale_hint autoscaling signal.
+        # Attached as an attribute (like drift_monitor) so the CLI can
+        # stop it at drain and the harness/frontend can read status().
+        if bool(serve_cfg["slo_enabled"]):
+            from .serving.slo import SLOConfig, SLOMonitor
+
+            target.slo_monitor = SLOMonitor(
+                target,
+                registry=telemetry.get_registry(),
+                config=SLOConfig(
+                    availability_objective=float(
+                        serve_cfg["slo_availability_objective"]
+                    ),
+                    latency_p95_ms=float(serve_cfg["slo_latency_p95_ms"]),
+                    fast_window_s=float(serve_cfg["slo_fast_window_s"]),
+                    window_s=float(serve_cfg["slo_window_s"]),
+                    interval_s=float(serve_cfg["slo_interval_s"]),
+                ),
+            )
+        return target
 
     def _with_drift_monitor(target):
         # bankops.baseline pins a win-share distribution; a background
@@ -472,12 +504,12 @@ def serve_from_archive(
             max_rows_per_pack=max_rows_per_pack,
         )
         predictor.encode_anchors(anchors)
-        return _with_drift_monitor(ScoringService(
+        return _with_slo_monitor(_with_drift_monitor(ScoringService(
             predictor,
             config=service_config,
             retry_policy=retry_policy,
             manifest_dir=out_dir,
-        ))
+        )))
 
     # -- replica fan-out: one service per assigned local device ------------
     if mesh is not None:
@@ -516,6 +548,7 @@ def serve_from_archive(
                     if out_dir is not None else None
                 ),
                 registry=registry,
+                device=device,  # serve.hbm_* gauges read THIS device
             )
 
         return factory
@@ -535,7 +568,7 @@ def serve_from_archive(
         "replica fleet: %d service(s) over %d local device(s)",
         n_replicas, len(devices),
     )
-    return _with_drift_monitor(ReplicaRouter(
+    return _with_slo_monitor(_with_drift_monitor(ReplicaRouter(
         replica_list,
         config=RouterConfig(
             heartbeat_timeout_s=float(serve_cfg["heartbeat_timeout_s"]),
@@ -544,7 +577,7 @@ def serve_from_archive(
             max_reroutes=int(serve_cfg["max_reroutes"]),
         ),
         retry_policy=retry_policy,
-    ))
+    )))
 
 
 def _auto_buckets_for_corpus(

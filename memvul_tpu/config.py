@@ -341,6 +341,23 @@ SERVING_DEFAULTS: Dict[str, Any] = {
     "max_batch_errors": 3,   # consecutive dead-letters before eviction
     "monitor_interval_s": 0.25,  # router health-check cadence
     "max_reroutes": 2,       # re-enqueue attempts after replica failures
+    # request-journey tracing (docs/observability.md, "Request
+    # tracing"): 0.0 = off and entirely free; > 0 stamps waypoints on
+    # every request, feeds the serve.queue_wait_s/pack_s/device_s/
+    # resolve_s stage histograms, and emits sampled `rtrace` events
+    # (always-on for non-served outcomes)
+    "trace_sample_rate": 0.0,
+    "trace_ring": 256,       # completed traces kept for GET /tracez
+    # SLO monitor (serving/slo.py): sliding-window availability +
+    # p95-latency attainment, multi-window burn rates, and the
+    # machine-readable scale_hint — published as slo.* gauges, the
+    # /healthz slo block, and the SLO-harness record
+    "slo_enabled": True,
+    "slo_availability_objective": 0.999,
+    "slo_latency_p95_ms": 1000.0,
+    "slo_fast_window_s": 60.0,   # spike-catcher burn window
+    "slo_window_s": 300.0,       # confirmation (slow) burn window
+    "slo_interval_s": 5.0,       # sampling cadence
 }
 
 
@@ -388,6 +405,10 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
     # jax.profiler trace dir for the run's hot section (the named-scope
     # map in docs/observability.md tells xprof time apart); None = off
     "trace_dir": None,
+    # serving HBM liveness: sample device_memory_stats into
+    # serve.hbm_in_use_bytes / serve.hbm_peak_bytes per replica at
+    # heartbeat cadence (no-op on backends without memory stats)
+    "hbm_gauges": True,
 }
 
 

@@ -40,3 +40,10 @@ from .loadgen import (  # noqa: F401
     request_deadlines,
     run_slo_harness,
 )
+from .slo import (  # noqa: F401
+    SCALE_DOWN,
+    SCALE_HOLD,
+    SCALE_UP,
+    SLOConfig,
+    SLOMonitor,
+)

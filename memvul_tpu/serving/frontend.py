@@ -7,7 +7,8 @@ the service's batcher thread — a handler that scored inline would
 serialize the whole server behind one connection and reintroduce the
 per-request-shape compiles the micro-batcher exists to prevent.
 
-API (JSON over ``http.server``; docs/serving.md):
+API (JSON over ``http.server``; docs/serving.md lists the endpoint
+table):
 
 * ``POST /score`` with ``{"text": "...", "deadline_ms": 500}`` →
   the service response (``status`` "ok" carries the per-anchor
@@ -18,11 +19,28 @@ API (JSON over ``http.server``; docs/serving.md):
   :class:`~memvul_tpu.serving.router.ReplicaRouter` — the per-replica
   health rows, so an external probe distinguishes "degraded fleet"
   from "healthy".  HTTP 200, or 503 once draining (a load balancer's
-  eviction signal — that contract is unchanged).
+  eviction signal — that contract is unchanged).  When an
+  :class:`~memvul_tpu.serving.slo.SLOMonitor` is attached the body
+  carries its ``slo`` block (attainment, burn rates, ``scale_hint``).
+* ``GET /metrics`` → the live registries in Prometheus text format
+  (telemetry/exposition.py; a router fans out per-replica parts with
+  ``replica`` labels).
+* ``GET /tracez[?limit=N]`` → the bounded ring of recent completed
+  request traces, newest first (serving/service.py tracing).
+* ``POST /profilez`` with ``{"seconds": N}`` → starts an on-demand
+  ``jax.profiler`` capture into the run dir while traffic keeps
+  flowing; 409 while one is already running, 503 when the server was
+  started without a run dir.
+
+The read endpoints only read **snapshots** — registry snapshots, the
+trace ring, the health summary; checker MV102 (static-analysis engine)
+rejects any scoring/encoding/packing call inside a handler class, so a
+scrape can never stall the batcher.
 
 The front end serves a single :class:`ScoringService` or a
 :class:`ReplicaRouter` interchangeably: both expose ``submit`` /
-``health_summary`` / ``default_deadline_ms``.
+``health_summary`` / ``metrics_snapshots`` / ``recent_traces`` /
+``default_deadline_ms``.
 
 The access log goes through ``logging`` (never print — the bare-print
 lint holds for serving code too).
@@ -33,8 +51,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..telemetry import get_registry
+from ..telemetry.exposition import render_target
+from ..utils.profiling import CaptureInProgress, ProfilerCapture
 from .service import (
     STATUS_DEADLINE,
     STATUS_DRAIN,
@@ -60,13 +82,20 @@ _RESULT_SLACK_S = 30.0
 
 
 class ScoringHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer carrying the service handle for handlers."""
+    """ThreadingHTTPServer carrying the service handle for handlers.
+
+    ``profile_dir`` arms ``POST /profilez`` (on-demand ``jax.profiler``
+    captures land in ``<profile_dir>/profile-<n>/``); without it the
+    endpoint answers 503."""
 
     daemon_threads = True
 
-    def __init__(self, address, service: ScoringService):
+    def __init__(self, address, service: ScoringService, profile_dir=None):
         super().__init__(address, ScoreHandler)
         self.service = service
+        self.profiler = (
+            ProfilerCapture(profile_dir) if profile_dir is not None else None
+        )
 
 
 class ScoreHandler(BaseHTTPRequestHandler):
@@ -90,14 +119,83 @@ class ScoreHandler(BaseHTTPRequestHandler):
 
     # -- routes ----------------------------------------------------------------
 
+    def _reply_text(self, http_status: int, text: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(http_status)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes ----------------------------------------------------------------
+
     def do_GET(self) -> None:
-        if self.path != "/healthz":
-            self._reply(404, {"status": "error", "reason": "unknown path"})
+        path, _, query = self.path.partition("?")
+        service = self.server.service
+        if path == "/healthz":
+            summary = service.health_summary()
+            # the SLO monitor is attached by build.serve_from_archive;
+            # its status() is a dict copy — a snapshot read, like
+            # everything else a handler may touch
+            monitor = getattr(service, "slo_monitor", None)
+            if monitor is not None:
+                summary["slo"] = monitor.status()
+            self._reply(503 if summary["draining"] else 200, summary)
             return
-        summary = self.server.service.health_summary()
-        self._reply(503 if summary["draining"] else 200, summary)
+        if path == "/metrics":
+            # registry snapshots rendered as Prometheus text — the live
+            # scrape surface (docs/observability.md "Live exposition")
+            self._reply_text(200, render_target(service))
+            return
+        if path == "/tracez":
+            params = urllib.parse.parse_qs(query)
+            try:
+                limit = int(params["limit"][0]) if "limit" in params else None
+            except (TypeError, ValueError):
+                self._reply(400, {
+                    "status": "error", "reason": "limit must be an integer",
+                })
+                return
+            traces = service.recent_traces(limit)
+            self._reply(200, {"count": len(traces), "traces": traces})
+            return
+        self._reply(404, {"status": "error", "reason": "unknown path"})
+
+    def _do_profilez(self) -> None:
+        profiler = self.server.profiler
+        if profiler is None:
+            self._reply(503, {
+                "status": "error",
+                "reason": "profiling disabled: serve was started without "
+                "a run dir (-o/--out-dir)",
+            })
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            seconds = float(payload["seconds"])
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {
+                "status": "error",
+                "reason": f"bad request: {type(e).__name__}: {e} "
+                '(expected {"seconds": N})',
+            })
+            return
+        try:
+            info = profiler.start(seconds)
+        except CaptureInProgress as e:
+            self._reply(409, {"status": "error", "reason": str(e)})
+            return
+        except ValueError as e:
+            self._reply(400, {"status": "error", "reason": str(e)})
+            return
+        get_registry().counter("serve.profile_captures").inc()
+        self._reply(200, {"status": "ok", **info})
 
     def do_POST(self) -> None:
+        if self.path == "/profilez":
+            self._do_profilez()
+            return
         if self.path != "/score":
             self._reply(404, {"status": "error", "reason": "unknown path"})
             return
@@ -141,20 +239,23 @@ def run_http_server(
     host: str = "127.0.0.1",
     port: int = 0,
     in_thread: bool = True,
+    profile_dir=None,
 ) -> ScoringHTTPServer:
     """Bind and start serving (port 0 = ephemeral; read the bound port
     off ``server.server_address``).  With ``in_thread`` the accept loop
     runs on a daemon thread and the server handle is returned
     immediately — call ``server.shutdown()`` then ``service.drain()``
-    to stop."""
-    server = ScoringHTTPServer((host, port), service)
+    to stop.  ``profile_dir`` (the serve CLI passes the run dir) arms
+    ``POST /profilez``."""
+    server = ScoringHTTPServer((host, port), service, profile_dir=profile_dir)
     if in_thread:
         thread = threading.Thread(
             target=server.serve_forever, name="memvul-serve-http", daemon=True
         )
         thread.start()
     logger.info(
-        "scoring service listening on http://%s:%d (POST /score, GET /healthz)",
+        "scoring service listening on http://%s:%d (POST /score, GET "
+        "/healthz, GET /metrics, GET /tracez, POST /profilez)",
         *server.server_address[:2],
     )
     return server

@@ -300,12 +300,21 @@ def run_slo_harness(
     config: Optional[LoadConfig] = None,
     replicas=None,
     router_registry=None,
+    slo_monitor=None,
 ) -> Dict[str, Any]:
     """One SLO measurement: drive ``target`` (service or router) with a
     load scenario and merge the client-side report with the fleet view.
     The record is a plain JSON-able dict — ``BENCH_MICRO=serve``'s
     router mode prints it verbatim, and the regression tests assert on
-    its fields rather than its prose."""
+    its fields rather than its prose.
+
+    With an :class:`~memvul_tpu.serving.slo.SLOMonitor` attached to the
+    target (``build.serve_from_archive`` does this) or passed
+    explicitly, the record gains its ``slo`` block — availability +
+    latency attainment vs the configured objectives, the multi-window
+    burn rates, and the machine-readable ``scale_hint`` — evaluated
+    once more after the load so the record reflects the run it sits
+    in."""
     report = LoadGenerator(target.submit, config).run(texts)
     record: Dict[str, Any] = {"load": report}
     if replicas is None:
@@ -320,4 +329,8 @@ def run_slo_harness(
             for name, value in counters.items()
             if name.startswith("router.")
         }
+    monitor = slo_monitor or getattr(target, "slo_monitor", None)
+    if monitor is not None:
+        monitor.tick()
+        record["slo"] = monitor.status()
     return record

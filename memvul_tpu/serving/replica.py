@@ -111,12 +111,21 @@ class Replica:
 
     # -- request path ----------------------------------------------------------
 
-    def submit(self, text: str, deadline_ms: Optional[float] = None) -> ScoreFuture:
+    def submit(
+        self,
+        text: str,
+        deadline_ms: Optional[float] = None,
+        trace_id: Optional[str] = None,
+        hops: int = 0,
+    ) -> ScoreFuture:
         """Enqueue on this replica's service.  Raises :class:`ReplicaDead`
         when the replica is dead — including the moment the
         ``replica.kill`` chaos point fires, which hard-kills this
         replica first so the caller re-routes against a genuinely dead
-        worker, not a healthy one wearing a costume."""
+        worker, not a healthy one wearing a costume.
+
+        ``trace_id``/``hops`` carry a router-assigned request journey
+        across re-routes (serving/service.py tracing)."""
         if self.state == REPLICA_DEAD:
             raise ReplicaDead(f"{self.name} is dead")
         try:
@@ -125,7 +134,9 @@ class Replica:
         except Exception as e:
             self.kill(reason=f"injected: {e}")
             raise ReplicaDead(f"{self.name} killed by fault injection") from e
-        return self.service.submit(text, deadline_ms=deadline_ms)
+        return self.service.submit(
+            text, deadline_ms=deadline_ms, trace_id=trace_id, hops=hops
+        )
 
     @property
     def queue_depth(self) -> int:

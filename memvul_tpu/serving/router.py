@@ -200,6 +200,32 @@ class ReplicaRouter:
             },
         }
 
+    # -- live exposition (GET /metrics, /tracez) --------------------------------
+
+    def metrics_snapshots(self) -> List:
+        """Snapshot parts for ``telemetry.exposition``: the router's own
+        registry (``router.*``) unlabeled, plus every replica's registry
+        under a ``replica`` label — the same fan-out shape as
+        ``health_summary()``, so a scrape separates members exactly the
+        way the on-disk ``replica-<i>/`` sinks do.  Registry reads only
+        (the handler/router lint's snapshot discipline)."""
+        parts: List = [({}, self._tel.snapshot())]
+        for replica in self.replicas:
+            parts.append(({"replica": replica.name}, replica.registry.snapshot()))
+        return parts
+
+    def recent_traces(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Fleet ``/tracez``: every replica's completed-trace ring,
+        merged newest-first (one in-process monotonic clock orders them
+        globally)."""
+        records: List[Dict[str, Any]] = []
+        for replica in self.replicas:
+            records.extend(replica.service.recent_traces())
+        records.sort(
+            key=lambda r: -(r.get("waypoints", {}).get("resolved") or 0.0)
+        )
+        return records[: int(limit)] if limit else records
+
     # -- dispatch --------------------------------------------------------------
 
     def submit(
@@ -266,8 +292,13 @@ class ReplicaRouter:
         with self._lock:
             self._outstanding[replica.name][request.rid] = request
         try:
+            # the router owns the journey id: a rerouted request keeps
+            # its rid-derived trace id with a grown hop count, so the
+            # replica-level rtrace records stitch into one story
+            # (ignored by replicas whose tracing is off)
             inner = replica.submit(
-                request.text, deadline_ms=self._remaining_ms(request)
+                request.text, deadline_ms=self._remaining_ms(request),
+                trace_id=f"r-{request.rid}", hops=request.attempts,
             )
         except ReplicaDead:
             with self._lock:
@@ -308,6 +339,10 @@ class ReplicaRouter:
             return
         out = dict(response)
         out["replica"] = replica.name
+        if request.attempts:
+            # how many replica deaths this journey survived — the SLO
+            # harness and the trace records split outcomes on it
+            out["reroutes"] = request.attempts
         if request.future.resolve(out) and status == STATUS_OK:
             self._tel.counter("router.served").inc()
 
@@ -324,7 +359,9 @@ class ReplicaRouter:
             and time.monotonic() > request.deadline_monotonic
         ):
             self._tel.counter("router.reroute_deadline").inc()
-            request.future.resolve({"status": STATUS_DEADLINE})
+            request.future.resolve({
+                "status": STATUS_DEADLINE, "reroutes": request.attempts,
+            })
             return
         request.attempts += 1
         if request.attempts > self.config.max_reroutes or self._draining.is_set():
@@ -332,6 +369,7 @@ class ReplicaRouter:
             request.future.resolve({
                 "status": STATUS_ERROR,
                 "reason": f"re-route attempts exhausted ({reason})",
+                "reroutes": request.attempts,
             })
             return
         self._tel.counter("router.reroutes").inc()

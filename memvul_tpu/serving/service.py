@@ -43,6 +43,18 @@ Failure routing: each micro-batch dispatch passes through the shared
 ``serve.batch`` fault point inside the retried window; a persistent
 failure dead-letters the batch — every affected request resolves
 ``"error"`` with the reason — instead of hanging its clients.
+
+Request-journey tracing (docs/observability.md, "Request tracing"):
+with ``trace_sample_rate > 0`` every request carries a :class:`_Trace`
+whose monotonic waypoints the batcher thread stamps as the journey
+advances — ``received → enqueued → coalesced`` (micro-batch id) ``→
+dispatched`` (bucket/pack shape + fill) ``→ device_done → resolved``
+(cause) — feeding the ``serve.queue_wait_s`` / ``serve.pack_s`` /
+``serve.device_s`` / ``serve.resolve_s`` stage histograms, a bounded
+ring ``GET /tracez`` reads, and sampled ``rtrace`` events (always-on
+for non-``ok`` outcomes).  At the default rate 0.0 tracing is entirely
+off: no stamps, no ring, no events, no extra metrics — the
+zero-overhead pin in tests/test_serving.py.
 """
 
 from __future__ import annotations
@@ -50,8 +62,10 @@ from __future__ import annotations
 import collections
 import dataclasses
 import hashlib
+import itertools
 import json
 import logging
+import os
 import signal
 import threading
 import time
@@ -90,6 +104,16 @@ class ServiceConfig:
     # bank.anchor_wins.<id> + a bank.anchor_score.<id> reservoir — the
     # raw material of the drift table (bankops/drift.py)
     anchor_stats: bool = True
+    # request-journey tracing: 0.0 = off entirely (the free default);
+    # > 0 stamps waypoints on every request, emits an `rtrace` event
+    # for ~this fraction of served requests (ALWAYS for non-served
+    # outcomes), and feeds the per-stage serve.*_s histograms
+    trace_sample_rate: float = 0.0
+    trace_ring: int = 256        # completed traces kept for GET /tracez
+    # sample device_memory_stats into serve.hbm_in_use_bytes /
+    # serve.hbm_peak_bytes at heartbeat cadence (no-op on backends
+    # without memory stats, e.g. CPU)
+    hbm_gauges: bool = True
 
 
 class ScoreFuture:
@@ -145,11 +169,77 @@ class ScoreFuture:
 
 
 @dataclasses.dataclass
+class _Trace:
+    """One request's journey: monotonic waypoints stamped by the
+    batcher thread (submit stamps the first two on the caller's way
+    into the queue — no emission work happens on a handler thread).
+    ``None`` = the journey never reached that stage (a shed request
+    has no ``dispatched``)."""
+
+    trace_id: str
+    hops: int = 0                # router re-route count (0 = first try)
+    received: Optional[float] = None
+    enqueued: Optional[float] = None
+    coalesced: Optional[float] = None
+    dispatched: Optional[float] = None
+    device_done: Optional[float] = None
+    resolved: Optional[float] = None
+    batch: Optional[int] = None  # micro-batch (pull) sequence number
+    shape: str = ""              # "bucket:RxL fill=n/R" | "pack:real/budget"
+    cause: str = ""              # terminal status (ok/shed/deadline/...)
+
+
+_WAYPOINT_ORDER = (
+    "received", "enqueued", "coalesced", "dispatched", "device_done",
+    "resolved",
+)
+# adjacent waypoint pairs → the stage duration they bound; the four
+# stages partition enqueued→resolved exactly, so their sum equals the
+# end-to-end latency by construction (the acceptance test's ≤5 ms gate)
+_STAGES = (
+    ("queue_wait_s", "enqueued", "coalesced"),
+    ("pack_s", "coalesced", "dispatched"),
+    ("device_s", "dispatched", "device_done"),
+    ("resolve_s", "device_done", "resolved"),
+)
+
+
+def _trace_record(trace: _Trace) -> Dict[str, Any]:
+    """The JSON shape of one completed trace — what the ring serves on
+    ``/tracez`` and the ``rtrace`` event carries."""
+    waypoints = {
+        name: getattr(trace, name)
+        for name in _WAYPOINT_ORDER
+        if getattr(trace, name) is not None
+    }
+    stages = {}
+    for stage, begin, end in _STAGES:
+        b, e = getattr(trace, begin), getattr(trace, end)
+        if b is not None and e is not None:
+            stages[stage] = e - b
+    record: Dict[str, Any] = {
+        "trace_id": trace.trace_id,
+        "cause": trace.cause,
+        "hops": trace.hops,
+        "waypoints": waypoints,
+        "stages": stages,
+    }
+    if trace.batch is not None:
+        record["batch"] = trace.batch
+    if trace.shape:
+        record["shape"] = trace.shape
+    if trace.resolved is not None and trace.enqueued is not None:
+        record["total_s"] = trace.resolved - trace.enqueued
+    return record
+
+
+@dataclasses.dataclass
 class _Request:
     text: str
     future: ScoreFuture
     enqueued_monotonic: float
     deadline_monotonic: Optional[float]  # None = no deadline
+    trace: Optional[_Trace] = None       # present only when tracing is on
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +281,7 @@ class ScoringService:
         retry_policy: Optional[RetryPolicy] = None,
         manifest_dir: Optional[Union[str, Path]] = None,
         registry=None,
+        device=None,
     ) -> None:
         if getattr(predictor, "anchor_bank", None) is None:
             raise RuntimeError(
@@ -248,6 +339,23 @@ class ScoringService:
         # process can host N replicas with separable health/counters;
         # the single-service path keeps the process-wide default
         self._tel = registry if registry is not None else get_registry()
+        # request-journey tracing (docs/observability.md): rate 0 means
+        # tracing never allocates, stamps, or emits anything
+        cfg = self.config
+        self._trace_enabled = cfg.trace_sample_rate > 0
+        self._trace_seq = itertools.count(1)
+        self._batch_seq = itertools.count(1)
+        self._trace_accum = 0.0  # batcher-thread-only sampling credit
+        self._trace_prefix = f"{os.getpid():x}"
+        self._trace_ring: "collections.deque[Dict[str, Any]]" = (
+            collections.deque(maxlen=max(1, int(cfg.trace_ring)))
+        )
+        self._ring_lock = threading.Lock()
+        # HBM liveness gauges: sampled on the batcher thread at the
+        # registry's heartbeat cadence; the device this service's bank
+        # lives on (None = the process default device)
+        self._device = device
+        self._hbm_next_monotonic = 0.0
         self._write_manifest()
         self._thread = threading.Thread(
             target=self._loop, name="memvul-serve-batcher", daemon=True
@@ -257,22 +365,40 @@ class ScoringService:
     # -- submission (any thread) ----------------------------------------------
 
     def submit(
-        self, text: str, deadline_ms: Optional[float] = None
+        self,
+        text: str,
+        deadline_ms: Optional[float] = None,
+        trace_id: Optional[str] = None,
+        hops: int = 0,
     ) -> ScoreFuture:
         """Enqueue one report text; returns immediately with a future.
 
         Admission control happens here: during drain the request is
         refused with ``"drain"``; on queue overflow the *oldest* queued
         request is shed with ``"shed"`` to make room (FIFO eviction —
-        the newest request has the freshest deadline)."""
+        the newest request has the freshest deadline).
+
+        ``trace_id``/``hops`` let the router carry one journey across
+        re-routes: a rerouted request keeps its id and its hop count
+        grows, so its trace records the whole story.  Both are ignored
+        when tracing is off."""
         future = ScoreFuture()
         now = time.monotonic()
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
         deadline = now + deadline_ms / 1000.0 if deadline_ms > 0 else None
+        trace = None
+        if self._trace_enabled:
+            trace = _Trace(
+                trace_id=trace_id
+                or f"{self._trace_prefix}-{next(self._trace_seq)}",
+                hops=int(hops),
+                received=now,
+            )
         request = _Request(
             text=text, future=future,
             enqueued_monotonic=now, deadline_monotonic=deadline,
+            trace=trace,
         )
         self._tel.counter("serve.requests").inc()
         if self._draining.is_set():
@@ -283,6 +409,8 @@ class ScoringService:
             if len(self._queue) >= self.config.max_queue:
                 shed = self._queue.popleft()
             self._queue.append(request)
+            if trace is not None:
+                trace.enqueued = time.monotonic()
             self._tel.gauge("serve.queue_depth").set(len(self._queue))
             self._cond.notify()
         if shed is not None:
@@ -363,6 +491,23 @@ class ScoringService:
                 "store_version": bank.store_version,
             },
         }
+
+    # -- live exposition (GET /metrics, /tracez) --------------------------------
+
+    def metrics_snapshots(self) -> List[Tuple[Dict[str, str], Dict[str, Any]]]:
+        """The snapshot parts ``telemetry.exposition`` renders for
+        ``GET /metrics`` — one unlabeled part for a bare service; the
+        router's override fans out per replica with ``replica`` labels.
+        A pure registry read (the handler contract: snapshots only)."""
+        return [({}, self._tel.snapshot())]
+
+    def recent_traces(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Completed request traces, newest first — the ``GET /tracez``
+        body.  Empty when tracing is off (the ring never fills)."""
+        with self._ring_lock:
+            records = list(self._trace_ring)
+        records.reverse()
+        return records[: int(limit)] if limit else records
 
     # -- shutdown --------------------------------------------------------------
 
@@ -555,6 +700,16 @@ class ScoringService:
             pulled = self._pull_batch()
             if not pulled:
                 continue
+            if self._trace_enabled:
+                # one coalesce stamp + micro-batch id for the whole
+                # pull: these requests now share a fate until dispatch
+                # splits them into shape chunks
+                coalesced = time.monotonic()
+                batch = next(self._batch_seq)
+                for request in pulled:
+                    if request.trace is not None:
+                        request.trace.coalesced = coalesced
+                        request.trace.batch = batch
             # the pull is the in-flight work; track it so a hard kill's
             # sweep can find requests that were popped but never resolved
             with self._cond:
@@ -569,6 +724,7 @@ class ScoringService:
                 return  # keep _inflight visible for take_unresolved
             with self._cond:
                 self._inflight = []
+            self._maybe_sample_hbm()
             self._tel.heartbeat()
         if self._killed.is_set():
             return  # a killed worker resolves nothing
@@ -597,6 +753,7 @@ class ScoringService:
             # batcher keeps its heartbeat age near zero, so the router's
             # missed-heartbeat eviction fires only on a genuinely wedged
             # replica, never an unloaded one
+            self._maybe_sample_hbm()
             self._tel.heartbeat()
         flush_at = time.monotonic() + cfg.max_wait_ms / 1000.0
         while len(pulled) < cfg.max_batch and not self._draining.is_set():
@@ -739,6 +896,19 @@ class ScoringService:
             faults.fault_point("serve.batch")
             return score_fn(self.predictor.params, sample, bank.array)
 
+        if self._trace_enabled:
+            # device_dispatch waypoint: tokenize/pad/pack is done, the
+            # device call is next — one stamp + shape label per chunk
+            dispatched = time.monotonic()
+            shape = (
+                f"pack:{real_tokens}/{padded_tokens}"
+                if self._score_impl == "ragged"
+                else f"bucket:{rows}x{length} fill={len(chunk)}/{rows}"
+            )
+            for request, _ in chunk:
+                if request.trace is not None:
+                    request.trace.dispatched = dispatched
+                    request.trace.shape = shape
         start = time.perf_counter()
         try:
             if self.retry_policy is None:
@@ -759,9 +929,15 @@ class ScoringService:
             response = {"status": STATUS_ERROR, "reason": reason}
             for request, _ in chunk:
                 request.future.resolve(dict(response))
+                self._finish_trace(request, STATUS_ERROR)
             return
         if self._killed.is_set():
             return  # killed mid-dispatch: the sweep accounts this chunk
+        if self._trace_enabled:
+            device_done = time.monotonic()
+            for request, _ in chunk:
+                if request.trace is not None:
+                    request.trace.device_done = device_done
         tel.histogram("serve.batch_latency_s").observe(
             time.perf_counter() - start
         )
@@ -807,6 +983,28 @@ class ScoringService:
                     (now - request.enqueued_monotonic) * 1e3, 3
                 ),
             })
+            trace = request.trace
+            if trace is not None:
+                # the four stage histograms partition enqueued→resolved
+                # exactly (docs/observability.md latency decomposition)
+                trace.resolved = now
+                if trace.coalesced is not None and trace.enqueued is not None:
+                    tel.histogram("serve.queue_wait_s").observe(
+                        trace.coalesced - trace.enqueued
+                    )
+                if trace.dispatched is not None and trace.coalesced is not None:
+                    tel.histogram("serve.pack_s").observe(
+                        trace.dispatched - trace.coalesced
+                    )
+                if trace.device_done is not None and trace.dispatched is not None:
+                    tel.histogram("serve.device_s").observe(
+                        trace.device_done - trace.dispatched
+                    )
+                if trace.device_done is not None:
+                    tel.histogram("serve.resolve_s").observe(
+                        now - trace.device_done
+                    )
+                self._finish_trace(request, STATUS_OK)
         tap = self._shadow_tap
         if tap is not None:
             # after resolution, so shadow sampling never adds to client
@@ -837,6 +1035,63 @@ class ScoringService:
         tel.counter("serve.shed").inc()
         tel.counter(sub).inc()
         request.future.resolve({"status": status})
+        self._finish_trace(request, status)
+
+    def _finish_trace(self, request: _Request, cause: str) -> None:
+        """Complete a request's trace: stamp the resolution, ring the
+        record for ``/tracez``, and emit an ``rtrace`` event — sampled
+        at ``trace_sample_rate`` for served requests, ALWAYS for
+        non-``ok`` outcomes (a shed or dead-lettered request is exactly
+        the one worth a post-mortem).  No-op when tracing is off."""
+        trace = request.trace
+        if trace is None:
+            return
+        trace.cause = cause
+        if trace.resolved is None:
+            trace.resolved = time.monotonic()
+        record = _trace_record(trace)
+        with self._ring_lock:
+            self._trace_ring.append(record)
+        if cause == STATUS_OK:
+            # deterministic credit sampling (batcher-thread-only state:
+            # ok resolutions all happen on the batcher)
+            self._trace_accum += self.config.trace_sample_rate
+            if self._trace_accum < 1.0:
+                return
+            self._trace_accum -= 1.0
+        self._tel.counter("serve.traces_sampled").inc()
+        self._tel.event("rtrace", **record)
+
+    def _maybe_sample_hbm(self) -> None:
+        """``serve.hbm_in_use_bytes`` / ``serve.hbm_peak_bytes``: the
+        device's live HBM view at heartbeat cadence — trainers have
+        reported this since PR 3, serving never did.  Backends without
+        ``memory_stats`` (CPU) report nothing and cost one probe per
+        heartbeat window."""
+        if not self.config.hbm_gauges:
+            return
+        now = time.monotonic()
+        if now < self._hbm_next_monotonic:
+            return
+        self._hbm_next_monotonic = now + max(
+            1.0, float(self._tel.heartbeat_every_s)
+        )
+        from ..utils import profiling
+
+        try:
+            stats = profiling.device_memory_stats(self._device)
+        except Exception:  # pragma: no cover - a device probe must
+            return         # never take the batcher down
+        if not stats:
+            return
+        if "bytes_in_use" in stats:
+            self._tel.gauge("serve.hbm_in_use_bytes").set(
+                stats["bytes_in_use"]
+            )
+        if "peak_bytes_in_use" in stats:
+            self._tel.gauge("serve.hbm_peak_bytes").set(
+                stats["peak_bytes_in_use"]
+            )
 
     def _shed_queue(self, status: str) -> None:
         while True:

@@ -19,3 +19,9 @@ from .registry import (  # noqa: F401
     reset,
 )
 from .sinks import read_jsonl  # noqa: F401
+from .exposition import (  # noqa: F401
+    parse_exposition,
+    render_exposition,
+    render_target,
+    sanitize_metric_name,
+)

@@ -1,0 +1,174 @@
+"""Prometheus text-format exposition of registry snapshots.
+
+The PR 3 telemetry layer is *offline*: per-process JSONL sinks read
+post-hoc by ``telemetry-report``.  A live fleet needs a scrape surface
+— this module renders any :meth:`TelemetryRegistry.snapshot` dict as
+`Prometheus text format
+<https://prometheus.io/docs/instrumenting/exposition_formats/>`_, which
+is what ``GET /metrics`` (serving/frontend.py) serves.
+
+Mapping (docs/observability.md, "Live exposition"):
+
+* counters → ``# TYPE <name> counter`` samples, gauges → ``gauge``;
+* histogram summaries → a Prometheus *summary*: ``<name>{quantile=..}``
+  for the reservoir percentiles plus ``<name>_sum`` / ``<name>_count``;
+* metric names are sanitized (``serve.queue_depth`` →
+  ``serve_queue_depth``; any other non-``[a-zA-Z0-9_:]`` byte becomes
+  ``_``) — the mapping is a bijection over the repo's metric catalog,
+  so a scrape agrees *exactly* with the snapshot it was rendered from
+  (pinned in tests/test_telemetry.py);
+* ``labels`` attach to every sample of a part — the router renders one
+  part per replica with ``{"replica": "replica-<i>"}``, mirroring
+  ``health_summary()``'s fan-out, so per-replica counters stay
+  separable at the scrape endpoint exactly as they are on disk.
+
+Rendering only *reads* snapshots: no locks beyond the registry's own
+snapshot lock, no device work — safe to call from an HTTP handler
+(checker MV102 holds the handlers to snapshot-read-only calls).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+# quantiles rendered for each histogram summary — the percentiles the
+# registry's reservoir already answers (registry.Histogram.summary)
+_SUMMARY_QUANTILES = (("0.5", "p50"), ("0.95", "p95"))
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+# one snapshot part: (labels, snapshot) — a bare service exposes one
+# unlabeled part, a router one part per replica plus its own
+SnapshotPart = Tuple[Mapping[str, str], Mapping[str, Any]]
+
+
+def sanitize_metric_name(name: str) -> str:
+    """``serve.queue_depth`` → ``serve_queue_depth`` (dots and every
+    other byte outside the Prometheus name alphabet become ``_``; a
+    leading digit is prefixed)."""
+    out = _NAME_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _escape_label_value(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace("\n", r"\n")
+        .replace('"', r"\"")
+    )
+
+
+def _label_str(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{sanitize_metric_name(k)}="{_escape_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _fmt_value(value: Any) -> str:
+    if isinstance(value, bool):  # bool is an int subclass; be explicit
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return "0"
+    return repr(f)
+
+
+def render_exposition(parts: Sequence[SnapshotPart]) -> str:
+    """Render snapshot parts as one Prometheus text document.
+
+    All samples of one metric are grouped under a single ``# TYPE``
+    line (the format's requirement), so two replicas' ``serve.served``
+    land adjacent with their ``replica`` labels telling them apart.
+    """
+    counters: Dict[str, List[str]] = {}
+    gauges: Dict[str, List[str]] = {}
+    summaries: Dict[str, List[str]] = {}
+    for labels, snapshot in parts:
+        label_str = _label_str(labels)
+        for name, value in (snapshot.get("counters") or {}).items():
+            metric = sanitize_metric_name(name)
+            counters.setdefault(metric, []).append(
+                f"{metric}{label_str} {_fmt_value(value)}"
+            )
+        for name, value in (snapshot.get("gauges") or {}).items():
+            if value is None:
+                continue
+            metric = sanitize_metric_name(name)
+            gauges.setdefault(metric, []).append(
+                f"{metric}{label_str} {_fmt_value(value)}"
+            )
+        for name, summary in (snapshot.get("histograms") or {}).items():
+            if not summary:
+                continue
+            metric = sanitize_metric_name(name)
+            lines = summaries.setdefault(metric, [])
+            for quantile, key in _SUMMARY_QUANTILES:
+                if summary.get(key) is None:
+                    continue
+                q_labels = dict(labels)
+                q_labels["quantile"] = quantile
+                lines.append(
+                    f"{metric}{_label_str(q_labels)} "
+                    f"{_fmt_value(summary[key])}"
+                )
+            lines.append(
+                f"{metric}_sum{label_str} "
+                f"{_fmt_value(summary.get('total', 0.0))}"
+            )
+            lines.append(
+                f"{metric}_count{label_str} "
+                f"{_fmt_value(int(summary.get('count', 0)))}"
+            )
+    out: List[str] = []
+    for metric in sorted(counters):
+        out.append(f"# TYPE {metric} counter")
+        out.extend(counters[metric])
+    for metric in sorted(gauges):
+        out.append(f"# TYPE {metric} gauge")
+        out.extend(gauges[metric])
+    for metric in sorted(summaries):
+        out.append(f"# TYPE {metric} summary")
+        out.extend(summaries[metric])
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def render_target(target) -> str:
+    """Render a serving target's live registries.
+
+    ``target`` is anything exposing ``metrics_snapshots()`` — a
+    :class:`~memvul_tpu.serving.service.ScoringService` (one unlabeled
+    part) or a :class:`~memvul_tpu.serving.router.ReplicaRouter` (its
+    own registry plus one ``replica``-labeled part per replica)."""
+    return render_exposition(target.metrics_snapshots())
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[str, float]]:
+    """Parse Prometheus text format back into
+    ``{metric: {label_str: value}}`` — the test-side half of the
+    exact-agreement contract (and a convenient scrape reader for the
+    SLO harness).  Raises ``ValueError`` on a malformed sample line, so
+    "parses as Prometheus text format" is a real assertion."""
+    out: Dict[str, Dict[str, float]] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$", line
+        )
+        if m is None:
+            raise ValueError(f"not a Prometheus sample line: {raw!r}")
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        out.setdefault(name, {})[labels] = float(value)
+    return out

@@ -74,6 +74,61 @@ _ROUTER_EVENT_KINDS = frozenset({
 })
 
 
+def _replica_rows(
+    run_dir: Path, events: List[Dict[str, Any]], now: float
+) -> Dict[str, Any]:
+    """The data behind the REPLICAS section (and the ``replicas`` block
+    of the ``--json`` report): per-replica counter rows from each
+    ``replica-<i>/`` subdir's own sinks, plus the router's lifecycle
+    event tallies from the main stream."""
+    replica_dirs = sorted(
+        d for d in run_dir.glob("replica-*") if d.is_dir()
+    )
+    router_events = [
+        ev for ev in events if ev.get("kind") in _ROUTER_EVENT_KINDS
+    ]
+    restarts: Dict[str, int] = {}
+    deaths: Dict[str, int] = {}
+    for ev in router_events:
+        name = str(ev.get("replica", "?"))
+        if ev.get("kind") == "replica_restart":
+            restarts[name] = restarts.get(name, 0) + 1
+        elif ev.get("kind") == "replica_dead":
+            deaths[name] = deaths.get(name, 0) + 1
+    rows: List[Dict[str, Any]] = []
+    for replica_dir in replica_dirs:
+        name = replica_dir.name
+        sub = load_run(replica_dir)
+        counters = dict((sub["summary"] or {}).get("counters") or {})
+        if not counters:
+            counters = dict((sub["heartbeat"] or {}).get("counters") or {})
+        if not (sub["events"] or sub["summary"] or sub["heartbeat"]):
+            rows.append({"name": name, "recorded": False})
+            continue
+        heartbeat = sub["heartbeat"] or {}
+        try:
+            age: Optional[float] = now - float(heartbeat.get("written_wall"))
+        except (TypeError, ValueError):
+            age = None
+        rows.append({
+            "name": name,
+            "recorded": True,
+            "heartbeat_age_s": age,
+            "served": counters.get("serve.served", 0),
+            "shed": counters.get("serve.shed", 0),
+            "errors": counters.get("serve.errors", 0),
+            "restarts": counters.get(
+                "replica.restarts", restarts.get(name, 0)
+            ),
+        })
+    return {
+        "router_events": len(router_events),
+        "deaths": sum(deaths.values()),
+        "restarts": sum(restarts.values()),
+        "members": rows,
+    }
+
+
 def _replica_section(
     run_dir: Path, events: List[Dict[str, Any]], now: float
 ) -> List[str]:
@@ -85,49 +140,26 @@ def _replica_section(
     telemetry disabled) renders as an explicit "(no telemetry
     recorded)" row instead of vanishing — its absence is exactly the
     post-mortem signal."""
-    replica_dirs = sorted(
-        d for d in run_dir.glob("replica-*") if d.is_dir()
-    )
-    router_events = [
-        ev for ev in events if ev.get("kind") in _ROUTER_EVENT_KINDS
-    ]
-    if not (replica_dirs or router_events):
+    data = _replica_rows(run_dir, events, now)
+    if not (data["members"] or data["router_events"]):
         return []
-    restarts: Dict[str, int] = {}
-    deaths: Dict[str, int] = {}
-    for ev in router_events:
-        name = str(ev.get("replica", "?"))
-        if ev.get("kind") == "replica_restart":
-            restarts[name] = restarts.get(name, 0) + 1
-        elif ev.get("kind") == "replica_dead":
-            deaths[name] = deaths.get(name, 0) + 1
     lines = ["REPLICAS"]
-    if router_events:
+    if data["router_events"]:
         lines.append(
-            f"  router events: {len(router_events)}"
-            + (f"  deaths: {sum(deaths.values())}" if deaths else "")
-            + (f"  restarts: {sum(restarts.values())}" if restarts else "")
+            f"  router events: {data['router_events']}"
+            + (f"  deaths: {data['deaths']}" if data["deaths"] else "")
+            + (f"  restarts: {data['restarts']}" if data["restarts"] else "")
         )
-    for replica_dir in replica_dirs:
-        name = replica_dir.name
-        sub = load_run(replica_dir)
-        counters = dict((sub["summary"] or {}).get("counters") or {})
-        if not counters:
-            counters = dict((sub["heartbeat"] or {}).get("counters") or {})
-        if not (sub["events"] or sub["summary"] or sub["heartbeat"]):
-            lines.append(f"  {name}: (no telemetry recorded)")
+    for row in data["members"]:
+        if not row["recorded"]:
+            lines.append(f"  {row['name']}: (no telemetry recorded)")
             continue
-        heartbeat = sub["heartbeat"] or {}
-        try:
-            age: Optional[float] = now - float(heartbeat.get("written_wall"))
-        except (TypeError, ValueError):
-            age = None
         lines.append(
-            f"  {name}: heartbeat {_fmt_s(age)} ago"
-            f"  served={_fmt_num(counters.get('serve.served', 0))}"
-            f"  shed={_fmt_num(counters.get('serve.shed', 0))}"
-            f"  errors={_fmt_num(counters.get('serve.errors', 0))}"
-            f"  restarts={_fmt_num(counters.get('replica.restarts', restarts.get(name, 0)))}"
+            f"  {row['name']}: heartbeat {_fmt_s(row['heartbeat_age_s'])} ago"
+            f"  served={_fmt_num(row['served'])}"
+            f"  shed={_fmt_num(row['shed'])}"
+            f"  errors={_fmt_num(row['errors'])}"
+            f"  restarts={_fmt_num(row['restarts'])}"
         )
     return lines
 
@@ -216,6 +248,126 @@ def _as_num(v: Any) -> float:
         return float(v)
     except (TypeError, ValueError):
         return 0.0
+
+
+# the per-request journey stages (serving/service.py tracing): together
+# they partition enqueued→resolved, so their totals decompose serve
+# latency into WHERE a request spent its time
+_LATENCY_STAGES = (
+    ("queue_wait", "serve.queue_wait_s"),
+    ("pack", "serve.pack_s"),
+    ("device", "serve.device_s"),
+    ("resolve", "serve.resolve_s"),
+)
+
+
+def _latency_decomposition(
+    histograms: Dict[str, Any],
+) -> Dict[str, Dict[str, Any]]:
+    """Stage rows (count/mean/p50/p95/share) from the serve stage
+    histograms; empty when the run never traced (sampling off)."""
+    rows: Dict[str, Dict[str, Any]] = {}
+    total = 0.0
+    for stage, metric in _LATENCY_STAGES:
+        h = histograms.get(metric) or {}
+        if not _as_num(h.get("count")):
+            continue
+        rows[stage] = {
+            "metric": metric,
+            "count": int(_as_num(h.get("count"))),
+            "total_s": _as_num(h.get("total")),
+            "mean_s": _as_num(h.get("mean")),
+            "p50_s": h.get("p50"),
+            "p95_s": h.get("p95"),
+        }
+        total += _as_num(h.get("total"))
+    for row in rows.values():
+        row["share"] = row["total_s"] / total if total > 0 else 0.0
+    return rows
+
+
+def _latency_section(histograms: Dict[str, Any]) -> List[str]:
+    rows = _latency_decomposition(histograms)
+    if not rows:
+        return []
+    lines = ["LATENCY DECOMPOSITION (request-journey stages)"]
+    lines.append(
+        f"  {'stage':<12} {'count':>7} {'mean':>10} {'p50':>10}"
+        f" {'p95':>10} {'share':>7}"
+    )
+    for stage, _metric in _LATENCY_STAGES:
+        row = rows.get(stage)
+        if row is None:
+            continue
+        lines.append(
+            f"  {stage:<12} {row['count']:>7}"
+            f" {_fmt_s(row['mean_s']):>10}"
+            f" {_fmt_s(row['p50_s']):>10}"
+            f" {_fmt_s(row['p95_s']):>10}"
+            f" {row['share']:>6.1%}"
+        )
+    return lines
+
+
+def _derived_metrics(counters: Dict[str, Any]) -> Dict[str, float]:
+    """The report-derived ratios (documented as ``derived`` in the
+    metric catalog) — shared by the text COUNTERS section and the
+    ``--json`` report."""
+    out: Dict[str, float] = {}
+    hits = _as_num(counters.get("data.encode_cache_hits"))
+    misses = _as_num(counters.get("data.encode_cache_misses"))
+    if hits + misses > 0:
+        out["data.encode_cache_hit_rate"] = hits / (hits + misses)
+    real = _as_num(counters.get("serve.tokens_real"))
+    padded = _as_num(counters.get("serve.tokens_padded"))
+    if padded > 0:
+        out["serve.real_token_utilization"] = real / padded
+    return out
+
+
+def report_json(
+    run_dir: Union[str, Path], now: Optional[float] = None
+) -> Dict[str, Any]:
+    """The machine-readable report (``telemetry-report --json``) — the
+    same sinks the text report renders, as one stable-schema dict so
+    bench/CI consume run summaries without scraping table text.  Top
+    keys are pinned by tests (the ``lint --json`` pattern): ``schema``,
+    ``run_dir``, ``events``, ``heartbeat``, ``spans``, ``counters``,
+    ``gauges``, ``histograms``, ``derived``, ``latency_decomposition``,
+    ``replicas``."""
+    data = load_run(run_dir)
+    now = time.time() if now is None else now
+    summary = data["summary"]
+    heartbeat = data["heartbeat"]
+    counters = dict(summary.get("counters") or {})
+    if not counters:
+        counters = dict((heartbeat or {}).get("counters") or {})
+    histograms = dict(summary.get("histograms") or {})
+    try:
+        heartbeat_age: Optional[float] = now - float(
+            heartbeat.get("written_wall")
+        )
+    except (TypeError, ValueError):
+        heartbeat_age = None
+    return {
+        "schema": 1,
+        "run_dir": str(data["run_dir"]),
+        "generated_wall": now,
+        "events": {
+            "parsed": len(data["events"]),
+            "skipped": data["events_skipped"],
+        },
+        "heartbeat": (
+            dict(heartbeat, age_s=heartbeat_age) if heartbeat else None
+        ),
+        "spans": _span_table(data["events"]),
+        "counters": counters,
+        "gauges": dict(summary.get("gauges") or {}),
+        "histograms": histograms,
+        "derived": _derived_metrics(counters),
+        "latency_decomposition": _latency_decomposition(histograms),
+        "replicas": _replica_rows(data["run_dir"], data["events"], now),
+    }
 
 
 def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str:
@@ -319,6 +471,12 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
                 f" p95={_fmt_num(h.get('p95'))}"
                 f" max={_fmt_num(h.get('max'))}"
             )
+
+    # -- serve latency decomposition (request-journey tracing) -----------------
+    latency_lines = _latency_section(summary.get("histograms") or {})
+    if latency_lines:
+        lines.append("")
+        lines.extend(latency_lines)
 
     # -- counters / gauges ----------------------------------------------------
     counters = dict(summary.get("counters") or {})

@@ -10,13 +10,18 @@ memory into the epoch metrics (reference: custom_trainer.py:309-316,
 * :func:`device_memory_stats` — per-device live/peak HBM bytes via the
   device ``memory_stats()`` API (absent on some backends → {});
 * :func:`trace_context` — a ``jax.profiler`` trace scope producing a
-  TensorBoard-loadable trace directory.
+  TensorBoard-loadable trace directory;
+* :class:`ProfilerCapture` — on-demand, one-at-a-time timed captures of
+  a LIVE process through the same trace scope (the serving tier's
+  ``POST /profilez`` endpoint, docs/serving.md).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
+from pathlib import Path
 from typing import Dict, Iterator, List, Optional
 
 import jax
@@ -159,3 +164,76 @@ def trace_context(log_dir: Optional[str]) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class CaptureInProgress(RuntimeError):
+    """A capture is already running — ``jax.profiler`` allows exactly
+    one trace at a time, so the caller gets a 409, not a crash."""
+
+
+class ProfilerCapture:
+    """One-at-a-time on-demand profiler captures of a live process.
+
+    ``start(seconds)`` opens a :func:`trace_context` on a background
+    thread for the requested duration and returns immediately with the
+    capture's trace dir — the serving tier's ``POST /profilez``
+    contract (docs/serving.md): the caller never blocks, and a second
+    start while one runs raises :class:`CaptureInProgress`.  Each
+    capture lands in its own ``profile-<n>/`` subdir of ``base_dir``
+    so successive captures never clobber each other.
+    """
+
+    def __init__(self, base_dir, max_seconds: float = 300.0) -> None:
+        self.base_dir = Path(base_dir)
+        self.max_seconds = float(max_seconds)
+        self._lock = threading.Lock()
+        self._busy = False
+        self._captures = 0
+
+    @property
+    def busy(self) -> bool:
+        with self._lock:
+            return self._busy
+
+    @property
+    def captures(self) -> int:
+        """Completed + in-flight captures this process started."""
+        with self._lock:
+            return self._captures
+
+    def start(self, seconds: float) -> Dict[str, object]:
+        """Begin one timed capture; returns ``{"trace_dir", "seconds"}``.
+        Raises ``ValueError`` on a non-positive/over-cap duration and
+        :class:`CaptureInProgress` while a capture runs."""
+        seconds = float(seconds)
+        if not (0.0 < seconds <= self.max_seconds):
+            raise ValueError(
+                f"seconds must be in (0, {self.max_seconds:g}], got {seconds!r}"
+            )
+        with self._lock:
+            if self._busy:
+                raise CaptureInProgress(
+                    "a profiler capture is already running (jax.profiler "
+                    "supports one trace at a time)"
+                )
+            self._busy = True
+            self._captures += 1
+            trace_dir = self.base_dir / f"profile-{self._captures:03d}"
+        thread = threading.Thread(
+            target=self._run,
+            args=(trace_dir, seconds),
+            name="memvul-profilez-capture",
+            daemon=True,
+        )
+        thread.start()
+        return {"trace_dir": str(trace_dir), "seconds": seconds}
+
+    def _run(self, trace_dir: Path, seconds: float) -> None:
+        try:
+            with trace_context(str(trace_dir)):
+                time.sleep(seconds)
+        except Exception:  # pragma: no cover - a failed capture must
+            pass           # never take the server with it
+        finally:
+            with self._lock:
+                self._busy = False

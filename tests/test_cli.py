@@ -736,6 +736,14 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         "--select", "--json", "--baseline", "--no-baseline",
         "--write-baseline", "--list-codes",
     } <= lint_flags
+    # telemetry-report's machine-readable output flag (PR 10) is pinned
+    # the same way: bench/CI consume it, so it cannot silently vanish
+    report_flags = {
+        flag
+        for action in sub.choices["telemetry-report"]._actions
+        for flag in action.option_strings
+    }
+    assert "--json" in report_flags
 
 
 def test_cli_bank_help_names_every_lifecycle_subcommand(capsys):

@@ -27,6 +27,7 @@ import os
 import signal
 import threading
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -545,3 +546,512 @@ def test_serve_from_archive_end_to_end(ws, tmp_path, tel):
     )
     with pytest.raises(ValueError, match="Siamese"):
         serve_from_archive(bad)
+
+
+# -- request-journey tracing (PR 10, docs/observability.md) --------------------
+
+_WAYPOINT_ORDER = (
+    "received", "enqueued", "coalesced", "dispatched", "device_done",
+    "resolved",
+)
+_STAGE_NAMES = ("queue_wait_s", "pack_s", "device_s", "resolve_s")
+
+
+def _assert_complete_monotonic(record):
+    """One served trace: every waypoint present, in order, and the four
+    stage durations sum to the end-to-end latency (≤5 ms slack)."""
+    waypoints = record["waypoints"]
+    assert set(waypoints) == set(_WAYPOINT_ORDER), record
+    values = [waypoints[name] for name in _WAYPOINT_ORDER]
+    assert values == sorted(values), record  # monotonic chain
+    stages = record["stages"]
+    assert set(stages) == set(_STAGE_NAMES), record
+    assert all(v >= 0 for v in stages.values()), record
+    assert abs(sum(stages.values()) - record["total_s"]) < 5e-3, record
+
+
+def test_tracing_full_sample_200_concurrent_chains_and_parity(setup, tel):
+    """The tentpole gate: sampling at 1.0 under the 200-concurrent
+    mixed-length load — every resolved request has a complete monotonic
+    waypoint chain whose stage durations sum to end-to-end latency,
+    scores stay bitwise-equal to direct scoring, zero mid-serve
+    recompiles, and exactly one rtrace event lands per request."""
+    predictor, _, texts = setup
+    n = 200
+    picks = [texts[i % len(texts)] for i in range(n)]
+    instances = [
+        {"text1": t, "label": "same", "meta": {"i": i}}
+        for i, t in enumerate(picks)
+    ]
+    expected = {}
+    for probs, metas in predictor.score_instances(iter(instances)):
+        for row, meta in zip(probs, metas):
+            expected[meta["i"]] = row.copy()
+    traces_before = predictor.score_trace_count
+
+    service = make_service(predictor, trace_sample_rate=1.0)
+    client = InprocessClient(service)
+    results = {}
+    lock = threading.Lock()
+
+    def worker(indices):
+        for i in indices:
+            response = client.score(picks[i])
+            with lock:
+                results[i] = response
+
+    threads = [
+        threading.Thread(target=worker, args=(range(k, n, 16),))
+        for k in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ring = service.recent_traces()
+    service.drain()
+
+    # tracing changed nothing about the scores or the compiled set
+    assert len(results) == n
+    for i in range(n):
+        assert results[i]["status"] == STATUS_OK
+        got = np.array(
+            [results[i]["predict"][label] for label in predictor.anchor_labels],
+            dtype=np.float32,
+        )
+        np.testing.assert_array_equal(got, np.asarray(expected[i], np.float32))
+    assert predictor.score_trace_count == traces_before
+
+    # every request produced one complete, monotonic, summing trace
+    assert len(ring) == n
+    assert all(r["cause"] == STATUS_OK for r in ring)
+    assert all(r["hops"] == 0 for r in ring)
+    for record in ring:
+        _assert_complete_monotonic(record)
+        assert record["batch"] >= 1
+        assert record["shape"].startswith("bucket:")
+    # newest-first ordering
+    resolved = [r["waypoints"]["resolved"] for r in ring]
+    assert resolved == sorted(resolved, reverse=True)
+    assert len(set(r["trace_id"] for r in ring)) == n
+
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.traces_sampled"] == n
+    hists = tel.snapshot()["histograms"]
+    for stage in _STAGE_NAMES:
+        assert hists[f"serve.{stage}"]["count"] == n
+    run_dir = tel.run_dir
+    tel.close()
+    events, skipped = telemetry.read_jsonl(run_dir / "events.jsonl")
+    assert skipped == 0
+    rtraces = [ev for ev in events if ev.get("kind") == "rtrace"]
+    assert len(rtraces) == n
+    assert {ev["trace_id"] for ev in rtraces} == {r["trace_id"] for r in ring}
+
+
+def test_tracing_off_zero_overhead_metric_and_event_pin(tel):
+    """The zero-overhead pin: with tracing off (the default), a served
+    load emits EXACTLY the PR 9 metric-name set — no stage histograms,
+    no trace counter, no rtrace events, an empty /tracez ring."""
+    fake = _SlowFakePredictor()
+    fake.hold.set()  # score immediately
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=1.0, max_queue=100,
+            default_deadline_ms=30000.0, anchor_stats=False,
+        ),
+    )
+    futures = [service.submit(f"r {i}") for i in range(40)]
+    for future in futures:
+        assert future.result(timeout=10)["status"] == STATUS_OK
+    assert service.recent_traces() == []
+    service.drain()
+    snapshot = tel.snapshot()
+    # the exact emitted-metric set of the pre-tracing serving tier
+    assert set(snapshot["counters"]) == {
+        "serve.requests", "serve.served", "serve.batches",
+        "serve.tokens_real", "serve.tokens_padded",
+    }
+    assert set(snapshot["gauges"]) == {"serve.queue_depth"}
+    assert set(snapshot["histograms"]) == {
+        "serve.latency_s", "serve.batch_latency_s", "serve.batch_occupancy",
+    }
+    run_dir = tel.run_dir
+    tel.close()
+    events, _ = telemetry.read_jsonl(run_dir / "events.jsonl")
+    kinds = {ev.get("kind") for ev in events}
+    assert "rtrace" not in kinds
+    assert kinds <= {"run_start", "serve_drained", "run_end"}
+
+
+def test_non_served_outcomes_always_traced_with_cause(tel):
+    """Shed / deadline / drain requests carry their cause even at a
+    near-zero sample rate: non-ok rtrace emission is always-on."""
+    fake = _SlowFakePredictor()
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=1.0, max_queue=4,
+            default_deadline_ms=50.0, trace_sample_rate=1e-9,
+        ),
+    )
+    first = service.submit("r0", deadline_ms=0)  # no deadline; blocks
+    assert fake.started.wait(timeout=5)
+    burst = [service.submit(f"r{i+1}", deadline_ms=50.0) for i in range(8)]
+    for future in burst[:4]:
+        assert future.result(timeout=5)["status"] == STATUS_SHED
+    time.sleep(0.1)
+    fake.hold.set()
+    assert first.result(timeout=10)["status"] == STATUS_OK
+    for future in burst[4:]:
+        assert future.result(timeout=10)["status"] == STATUS_DEADLINE
+    service.drain()
+    causes = {}
+    for record in service.recent_traces():
+        causes[record["cause"]] = causes.get(record["cause"], 0) + 1
+        assert "hops" in record
+    assert causes[STATUS_SHED] == 4
+    assert causes[STATUS_DEADLINE] == 4
+    assert causes.get(STATUS_OK, 0) == 1  # ringed even when not sampled
+    # a shed request's trace never reached dispatch
+    shed_traces = [
+        r for r in service.recent_traces() if r["cause"] == STATUS_SHED
+    ]
+    assert all("dispatched" not in r["waypoints"] for r in shed_traces)
+    run_dir = tel.run_dir
+    tel.close()
+    events, _ = telemetry.read_jsonl(run_dir / "events.jsonl")
+    rtraces = [ev for ev in events if ev.get("kind") == "rtrace"]
+    # at a ~0 sample rate only the 8 non-ok outcomes emit events
+    assert len(rtraces) == 8
+    assert {ev["cause"] for ev in rtraces} == {STATUS_SHED, STATUS_DEADLINE}
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.traces_sampled"] == 8
+
+
+# -- live exposition endpoints (GET /metrics, /tracez) -------------------------
+
+def _http_get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_metrics_endpoint_parses_and_agrees_with_snapshot(tel):
+    """GET /metrics parses as Prometheus text format and agrees exactly
+    with TelemetryRegistry.snapshot() at scrape time."""
+    from memvul_tpu.telemetry.exposition import (
+        parse_exposition, sanitize_metric_name,
+    )
+
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=1.0, default_deadline_ms=30000.0,
+        ),
+    )
+    server = run_http_server(service, port=0)
+    try:
+        base = "http://%s:%d" % server.server_address[:2]
+        for i in range(9):
+            assert service.submit(f"r {i}").result(timeout=10)[
+                "status"
+            ] == STATUS_OK
+        snapshot = tel.snapshot()
+        status, ctype, body = _http_get(base, "/metrics")
+        assert status == 200
+        assert "text/plain" in ctype
+        parsed = parse_exposition(body.decode("utf-8"))  # raises if malformed
+        for name, value in snapshot["counters"].items():
+            assert parsed[sanitize_metric_name(name)][""] == value, name
+        for name, value in snapshot["gauges"].items():
+            assert parsed[sanitize_metric_name(name)][""] == value, name
+        for name, summary in snapshot["histograms"].items():
+            metric = sanitize_metric_name(name)
+            assert parsed[f"{metric}_count"][""] == summary["count"], name
+            assert abs(
+                parsed[f"{metric}_sum"][""] - summary["total"]
+            ) < 1e-9, name
+    finally:
+        server.shutdown()
+        service.drain()
+
+
+def test_tracez_endpoint_newest_first_and_limit(tel):
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=2, max_wait_ms=1.0, default_deadline_ms=30000.0,
+            trace_sample_rate=1.0, trace_ring=16,
+        ),
+    )
+    server = run_http_server(service, port=0)
+    try:
+        base = "http://%s:%d" % server.server_address[:2]
+        for i in range(10):
+            assert service.submit(f"r {i}").result(timeout=10)[
+                "status"
+            ] == STATUS_OK
+        status, _, body = _http_get(base, "/tracez")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["count"] == 10
+        resolved = [
+            t["waypoints"]["resolved"] for t in payload["traces"]
+        ]
+        assert resolved == sorted(resolved, reverse=True)
+        status, _, body = _http_get(base, "/tracez?limit=3")
+        assert json.loads(body)["count"] == 3
+        # a bounded ring: flooding past trace_ring keeps the newest 16
+        for i in range(20):
+            service.submit(f"flood {i}").result(timeout=10)
+        status, _, body = _http_get(base, "/tracez")
+        assert json.loads(body)["count"] == 16
+    finally:
+        server.shutdown()
+        service.drain()
+
+
+def test_healthz_carries_slo_block_when_monitor_attached(tel):
+    from memvul_tpu.serving.slo import SLOConfig, SLOMonitor
+
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    service = ScoringService(
+        fake, config=ServiceConfig(max_wait_ms=1.0),
+    )
+    service.slo_monitor = SLOMonitor(
+        service, registry=tel, config=SLOConfig(interval_s=0.0), start=False,
+    )
+    server = run_http_server(service, port=0)
+    try:
+        base = "http://%s:%d" % server.server_address[:2]
+        assert service.submit("hello").result(timeout=10)["status"] == STATUS_OK
+        service.slo_monitor.tick()
+        status, _, body = _http_get(base, "/healthz")
+        assert status == 200
+        slo = json.loads(body)["slo"]
+        assert slo["scale_hint"] in ("up", "hold", "down")
+        assert slo["objectives"]["availability"] == 0.999
+        assert 0.0 <= slo["availability"] <= 1.0
+        gauges = tel.snapshot()["gauges"]
+        assert "slo.availability" in gauges and "slo.scale_hint" in gauges
+    finally:
+        server.shutdown()
+        service.drain()
+
+
+def test_profilez_capture_conflict_and_disabled(tel, tmp_path):
+    """POST /profilez starts one capture at a time: 200 with the trace
+    dir, 409 while running, 400 on junk, 503 without a run dir."""
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    service = ScoringService(fake, config=ServiceConfig(max_wait_ms=1.0))
+    prof_dir = tmp_path / "prof"
+    server = run_http_server(service, port=0, profile_dir=prof_dir)
+    no_prof = run_http_server(service, port=0)  # no run dir: disabled
+
+    def post(srv, payload):
+        base = "http://%s:%d" % srv.server_address[:2]
+        req = urllib.request.Request(
+            base + "/profilez",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        status, payload = post(server, {"seconds": 0.4})
+        assert status == 200 and payload["status"] == "ok"
+        assert payload["seconds"] == 0.4
+        # capture in flight: a second request conflicts
+        status, payload = post(server, {"seconds": 0.1})
+        assert status == 409 and "already running" in payload["reason"]
+        # serving continues during the capture
+        assert service.submit("live").result(timeout=10)["status"] == STATUS_OK
+        deadline = time.monotonic() + 10
+        while server.profiler.busy and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not server.profiler.busy
+        assert (prof_dir / "profile-001").is_dir()
+        assert tel.snapshot()["counters"]["serve.profile_captures"] == 1
+        # bad/missing duration → 400; no run dir → 503
+        assert post(server, {"seconds": "soon"})[0] == 400
+        assert post(server, {})[0] == 400
+        assert post(server, {"seconds": -1})[0] == 400
+        assert post(no_prof, {"seconds": 0.1})[0] == 503
+    finally:
+        server.shutdown()
+        no_prof.shutdown()
+        service.drain()
+
+
+def test_hbm_gauges_sampled_at_heartbeat_cadence(tel, monkeypatch):
+    """The batcher samples device_memory_stats into serve.hbm_* gauges
+    at heartbeat cadence — per replica, the way trainers already report
+    it (monkeypatched stats: CPU exposes none)."""
+    from memvul_tpu.utils import profiling
+
+    seen_devices = []
+
+    def fake_stats(device=None, all_devices=False):
+        seen_devices.append(device)
+        return {"bytes_in_use": 123.0, "peak_bytes_in_use": 456.0}
+
+    monkeypatch.setattr(profiling, "device_memory_stats", fake_stats)
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    sentinel = object()
+    service = ScoringService(
+        fake, config=ServiceConfig(max_wait_ms=1.0), device=sentinel,
+    )
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not seen_devices:
+            time.sleep(0.02)
+        gauges = tel.snapshot()["gauges"]
+        assert gauges["serve.hbm_in_use_bytes"] == 123.0
+        assert gauges["serve.hbm_peak_bytes"] == 456.0
+        assert seen_devices[0] is sentinel  # THIS replica's device
+    finally:
+        service.drain()
+    # the gate: hbm_gauges=False never probes the device
+    seen_devices.clear()
+    telemetry.configure(run_dir=tel.run_dir)
+    off = ScoringService(
+        fake, config=ServiceConfig(max_wait_ms=1.0, hbm_gauges=False),
+    )
+    time.sleep(0.2)
+    off.drain()
+    assert seen_devices == []
+
+
+def test_profilez_via_serve_cli_subprocess(ws, tmp_path):
+    """The satellite gate: a real `serve` process captures an on-demand
+    jax.profiler trace into its run dir while serving live traffic —
+    409 while one is running — and still drains cleanly on SIGTERM."""
+    import subprocess
+    import sys as _sys
+
+    from memvul_tpu.archive import save_archive
+    from memvul_tpu.build import build_model, init_params
+
+    model_cfg = {
+        "type": "model_memory",
+        "encoder": {"preset": "tiny", "vocab_size": 4096},
+        "header_dim": 32,
+    }
+    config = {
+        "tokenizer": {
+            "type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"],
+        },
+        "dataset_reader": {
+            "type": "reader_memory",
+            "anchor_path": ws["paths"]["anchors"],
+            "cve_path": ws["paths"]["cve"],
+        },
+        "model": model_cfg,
+        "serving": {"max_batch": 4, "buckets": [16], "max_length": 16},
+    }
+    model = build_model(dict(model_cfg), 4096)
+    archive = save_archive(
+        tmp_path / "model.tar.gz", config, init_params(model, seed=0),
+        tokenizer_file=ws["paths"]["tokenizer"],
+    )
+    out_dir = tmp_path / "serve_run"
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "memvul_tpu", "serve", str(archive),
+         "-o", str(out_dir), "--port", "0", "--no-mesh"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        line = proc.stdout.readline()
+        if not line.strip():
+            proc.kill()
+            _, err = proc.communicate(timeout=30)
+            raise AssertionError(f"serve never became ready: {err[-3000:]}")
+        ready = json.loads(line)
+        base = ready["serving"]
+
+        def post_profilez(payload):
+            req = urllib.request.Request(
+                base + "/profilez",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        status, payload = post_profilez({"seconds": 1.0})
+        assert status == 200, payload
+        assert payload["trace_dir"].startswith(str(out_dir))
+        # conflict while the capture runs
+        status, conflict = post_profilez({"seconds": 0.1})
+        assert status == 409, conflict
+        # live traffic keeps flowing during the capture
+        score_req = urllib.request.Request(
+            base + "/score",
+            data=json.dumps({"text": "a memory safety bug"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(score_req, timeout=30) as resp:
+            assert json.loads(resp.read())["status"] == STATUS_OK
+        # the capture finishes and leaves a trace dir in the run dir
+        deadline = time.monotonic() + 15
+        profile_dir = Path(payload["trace_dir"])
+        while time.monotonic() < deadline and not profile_dir.is_dir():
+            time.sleep(0.1)
+        assert profile_dir.is_dir()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def test_ragged_dispatch_traces_pack_fill(tel):
+    """Ragged mode's trace shape records the token-budget fill
+    (pack:real/budget) instead of a bucket, with the same complete
+    stage chain."""
+    fake = _SlowFakePredictor()
+    fake.hold.set()
+    fake.score_impl = "ragged"
+    fake.ragged_shape = lambda: (32, 4)
+    fake._ragged_score_fn = lambda params, sample, bank: np.tile(
+        np.linspace(0.1, 0.9, fake.n_anchors, dtype=np.float32), (4, 1)
+    )
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=1.0, default_deadline_ms=30000.0,
+            trace_sample_rate=1.0,
+        ),
+    )
+    futures = [service.submit(f"req {i}") for i in range(6)]
+    for future in futures:
+        assert future.result(timeout=10)["status"] == STATUS_OK
+    ring = service.recent_traces()
+    service.drain()
+    assert len(ring) == 6
+    for record in ring:
+        _assert_complete_monotonic(record)
+        real, budget = record["shape"].split(":", 1)[1].split("/")
+        assert real.isdigit() and int(budget) == 32
+    hists = tel.snapshot()["histograms"]
+    assert hists["serve.pack_s"]["count"] == 6

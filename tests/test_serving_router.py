@@ -860,3 +860,177 @@ def test_serve_router_microbench_emits_parseable_record(monkeypatch, capsys):
     assert abs(sum(m["utilization"] for m in fleet["replicas"]) - 1.0) < 1e-6
     assert record["config"]["replicas"] == 2
     assert record["config"]["pattern"] == "closed"
+    # the record carries the SLO evaluation (PR 10): attainment vs
+    # objectives + the machine-readable autoscaling signal
+    slo = record["slo"]
+    assert slo["scale_hint"] in ("up", "hold", "down")
+    assert slo["availability"] == 1.0  # every request served
+    assert slo["burn_rate_fast"] == 0.0
+
+
+# -- live exposition + tracing through the fleet (PR 10) -----------------------
+
+def test_router_metrics_fan_out_per_replica_labels():
+    """GET /metrics over a router: one part per replica with replica
+    labels, agreeing exactly with each replica's own registry."""
+    from memvul_tpu.telemetry.exposition import parse_exposition, render_target
+
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, replicas = fake_fleet(n=2)
+        for i in range(12):
+            assert router.submit(f"r {i}").result(timeout=10)[
+                "status"
+            ] == STATUS_OK
+        parsed = parse_exposition(render_target(router))
+        total = 0
+        for replica in replicas:
+            label = '{replica="%s"}' % replica.name
+            served = replica.registry.snapshot()["counters"]["serve.served"]
+            assert parsed["serve_served"][label] == served
+            total += served
+        assert total == 12
+        # the router's own metrics render unlabeled
+        routed = registry.snapshot()["counters"]["router.routed"]
+        assert parsed["router_routed"][""] == routed
+        # the HTTP endpoint serves the identical fan-out
+        server = run_http_server(router, port=0)
+        try:
+            base = "http://%s:%d" % server.server_address[:2]
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                body = r.read().decode()
+            assert parse_exposition(body)["serve_requests"].keys() == {
+                '{replica="replica-0"}', '{replica="replica-1"}',
+            }
+        finally:
+            server.shutdown()
+        router.drain()
+    finally:
+        telemetry.reset()
+
+
+def test_rerouted_request_keeps_trace_id_and_carries_hops():
+    """A replica death mid-journey: the response records the re-route
+    count, and the replica-level trace carries the SAME router-assigned
+    trace id with hops > 0 — one story across two replicas."""
+    router, replicas = fake_fleet(
+        n=2, auto_restart=False,
+        service_overrides={"trace_sample_rate": 1.0},
+    )
+    warm = [router.submit(f"warm {i}").result(timeout=10) for i in range(4)]
+    assert all(r["status"] == STATUS_OK for r in warm)
+    assert all("reroutes" not in r for r in warm)
+    faults.configure("replica.kill.replica-0=raise:RuntimeError:chaos")
+    responses = [
+        router.submit(f"post-kill {i}").result(timeout=15) for i in range(8)
+    ]
+    assert all(r["status"] == STATUS_OK for r in responses)
+    rerouted = [r for r in responses if r.get("reroutes")]
+    assert rerouted, "the kill never forced a re-route"
+    assert all(r["replica"] == "replica-1" for r in rerouted)
+    # the surviving replica's ring carries the hop counts
+    hopped = [
+        t for t in replicas[1].service.recent_traces() if t["hops"] > 0
+    ]
+    assert len(hopped) == len(rerouted)
+    assert all(t["trace_id"].startswith("r-") for t in hopped)
+    assert all(t["cause"] == STATUS_OK for t in hopped)
+    # the fleet /tracez merge sees every completed journey, newest first
+    merged = router.recent_traces()
+    assert len(merged) == len(
+        replicas[0].service.recent_traces()
+    ) + len(replicas[1].service.recent_traces())
+    resolved = [t["waypoints"]["resolved"] for t in merged]
+    assert resolved == sorted(resolved, reverse=True)
+    assert len(router.recent_traces(limit=2)) == 2
+    router.drain()
+    assert_fleet_invariant(replicas)
+
+
+# -- SLO monitor over the fleet ------------------------------------------------
+
+def test_slo_harness_record_gains_slo_block():
+    """run_slo_harness folds the monitor's evaluation into the record:
+    attainment, burn rates, scale_hint."""
+    from memvul_tpu.serving.slo import SLOConfig, SLOMonitor
+
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, replicas = fake_fleet(n=2)
+        monitor = SLOMonitor(
+            router, registry=registry,
+            config=SLOConfig(interval_s=1.0), start=False,
+        )
+        monitor.tick()
+        record = run_slo_harness(
+            router,
+            ["a short report", "a rather longer report text"],
+            LoadConfig(pattern="poisson", requests=48, rps=2000.0, seed=3),
+            slo_monitor=monitor,
+        )
+        router.drain()
+        slo = record["slo"]
+        assert slo["scale_hint"] in ("up", "hold", "down")
+        assert slo["availability"] == 1.0  # every request served
+        assert slo["burn_rate_fast"] == 0.0
+        assert record["load"]["outcomes"]["hang"] == 0
+        # an attached monitor is found without being passed explicitly
+        router2, _ = fake_fleet(n=1)
+        router2.slo_monitor = SLOMonitor(
+            router2, registry=registry,
+            config=SLOConfig(interval_s=1.0), start=False,
+        )
+        record2 = run_slo_harness(
+            router2, ["text"],
+            LoadConfig(pattern="closed", requests=8, clients=2),
+        )
+        router2.drain()
+        assert "slo" in record2
+    finally:
+        telemetry.reset()
+
+
+def test_replica_sigkill_chaos_flips_scale_hint_up():
+    """The loadgen chaos gate: a replica hard-killed with queued work
+    books its casualties as errors, and the next SLO evaluation flips
+    scale_hint to up (burn rate over 1)."""
+    from memvul_tpu.serving.slo import SLOConfig, SLOMonitor
+
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, replicas = fake_fleet(
+            n=1, auto_restart=False, monitor_interval_s=3600.0,
+            max_reroutes=0,
+        )
+        monitor = SLOMonitor(
+            router, registry=registry,
+            config=SLOConfig(interval_s=1.0), start=False,
+        )
+        monitor.tick()
+        # healthy traffic first: not burning
+        for i in range(8):
+            assert router.submit(f"ok {i}").result(timeout=10)[
+                "status"
+            ] == STATUS_OK
+        assert monitor.tick()["scale_hint"] != "up"
+        # SIGKILL semantics mid-load: block the batcher, queue work,
+        # kill, sweep — serve.errors jumps while serve.served stalls
+        hold = threading.Event()
+        replicas[0].service.predictor.hold = hold
+        futures = [router.submit(f"r {i}", deadline_ms=0) for i in range(12)]
+        time.sleep(0.05)
+        replicas[0].kill(reason="chaos")
+        hold.set()
+        replicas[0].sweep_unresolved()
+        router._reclaim(replicas[0], reason="chaos")
+        for f in futures:
+            assert f.result(timeout=5)["status"] == "error"
+        status = monitor.tick()
+        assert status["availability_fast"] < 1.0
+        assert status["burn_rate_fast"] > 1.0
+        assert status["scale_hint"] == "up"
+        assert registry.snapshot()["gauges"]["slo.scale_hint"] == 1.0
+        router.drain()
+        assert_fleet_invariant(replicas)
+    finally:
+        telemetry.reset()

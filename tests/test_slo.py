@@ -1,0 +1,268 @@
+"""SLO monitor (serving/slo.py): sliding-window availability +
+latency attainment, multi-window burn rates, and the machine-readable
+scale_hint — unit-tested against a stub target whose counters and
+clock the test controls, so every window boundary is deterministic.
+Fleet integration (real router, chaos flip) lives in
+tests/test_serving_router.py."""
+
+import pytest
+
+from memvul_tpu.serving.slo import (
+    SCALE_DOWN,
+    SCALE_HOLD,
+    SCALE_UP,
+    SLOConfig,
+    SLOMonitor,
+)
+from memvul_tpu.telemetry import TelemetryRegistry
+
+
+class _StubTarget:
+    """A fake serving target: metrics_snapshots() + queue_depth, with
+    test-writable counters/histograms."""
+
+    def __init__(self):
+        self.counters = {
+            "serve.requests": 0, "serve.served": 0, "serve.shed": 0,
+            "serve.errors": 0, "serve.shed_overflow": 0,
+            "serve.shed_deadline": 0,
+        }
+        self.p95_s = None
+        self.occupancy = None  # (count, total)
+        self.queue_depth = 0
+
+    def serve(self, n):
+        self.counters["serve.requests"] += n
+        self.counters["serve.served"] += n
+
+    def fail(self, n):
+        self.counters["serve.requests"] += n
+        self.counters["serve.errors"] += n
+
+    def metrics_snapshots(self):
+        hists = {}
+        if self.p95_s is not None:
+            hists["serve.latency_s"] = {
+                "count": 1.0, "total": self.p95_s, "mean": self.p95_s,
+                "min": self.p95_s, "max": self.p95_s,
+                "p50": self.p95_s, "p95": self.p95_s,
+            }
+        if self.occupancy is not None:
+            count, total = self.occupancy
+            hists["serve.batch_occupancy"] = {
+                "count": count, "total": total,
+                "mean": total / count if count else 0.0,
+            }
+        return [({}, {
+            "counters": dict(self.counters),
+            "gauges": {},
+            "histograms": hists,
+        })]
+
+
+def make_monitor(registry=None, **overrides):
+    defaults = dict(
+        availability_objective=0.99, latency_p95_ms=100.0,
+        fast_window_s=60.0, window_s=300.0, interval_s=5.0,
+    )
+    defaults.update(overrides)
+    target = _StubTarget()
+    monitor = SLOMonitor(
+        target,
+        registry=registry or TelemetryRegistry(enabled=True),
+        config=SLOConfig(**defaults),
+        capacity=100,
+        start=False,  # tests drive tick(now=...) directly
+    )
+    return target, monitor
+
+
+def test_no_traffic_is_healthy_not_burning():
+    """An idle fleet has availability 1.0, zero burn, and (once the
+    window has ≥2 quiet samples) a scale-down hint."""
+    target, monitor = make_monitor()
+    status = monitor.tick(now=1000.0)
+    assert status["availability"] == 1.0
+    assert status["burn_rate_fast"] == 0.0
+    assert status["scale_hint"] == SCALE_HOLD  # one sample: no window yet
+    status = monitor.tick(now=1030.0)
+    assert status["scale_hint"] == SCALE_DOWN
+    assert status["error_budget_remaining"] == 1.0
+    assert status["samples"] == 2
+
+
+def test_errors_burn_budget_and_flip_scale_up():
+    """Errors inside the fast window push the burn rate past 1.0 and
+    flip the hint to up; availability reflects the windowed ratio."""
+    target, monitor = make_monitor()
+    monitor.tick(now=1000.0)
+    target.serve(90)
+    target.fail(10)
+    status = monitor.tick(now=1030.0)
+    assert status["availability_fast"] == pytest.approx(0.9)
+    # (1 - 0.9) / (1 - 0.99) = 10x burn
+    assert status["burn_rate_fast"] == pytest.approx(10.0)
+    assert status["scale_hint"] == SCALE_UP
+    assert status["error_budget_remaining"] == 0.0
+
+
+def test_burn_recovers_once_errors_age_out_of_both_windows():
+    """Burn is windowed, not cumulative: the same error total stops
+    burning once the window has slid past it."""
+    target, monitor = make_monitor()
+    monitor.tick(now=1000.0)
+    target.fail(10)
+    assert monitor.tick(now=1010.0)["scale_hint"] == SCALE_UP
+    # 400s later both windows contain only clean traffic
+    target.serve(50)
+    monitor.tick(now=1400.0)
+    target.serve(50)
+    status = monitor.tick(now=1420.0)
+    assert status["burn_rate_fast"] == 0.0
+    assert status["burn_rate_slow"] == 0.0
+    assert status["scale_hint"] != SCALE_UP
+
+
+def test_backlog_and_overflow_shedding_flip_scale_up():
+    target, monitor = make_monitor()
+    monitor.tick(now=1000.0)
+    target.serve(10)
+    target.queue_depth = 60  # 60% of capacity 100
+    assert monitor.tick(now=1010.0)["scale_hint"] == SCALE_UP
+    # overflow shedding alone (backlog already drained) also means up
+    target2, monitor2 = make_monitor()
+    monitor2.tick(now=1000.0)
+    target2.serve(10)
+    target2.counters["serve.shed_overflow"] += 3
+    status = monitor2.tick(now=1010.0)
+    assert status["scale_hint"] == SCALE_UP
+
+
+def test_latency_breach_flips_scale_up_and_attainment_drops():
+    target, monitor = make_monitor()
+    target.p95_s = 0.01  # objective is 100ms
+    monitor.tick(now=1000.0)
+    target.serve(10)
+    status = monitor.tick(now=1010.0)
+    assert status["latency_attainment"] == 1.0
+    assert status["scale_hint"] != SCALE_UP
+    target.p95_s = 0.5  # 5x the objective
+    target.serve(10)
+    monitor.tick(now=1020.0)
+    target.serve(10)
+    status = monitor.tick(now=1030.0)
+    assert status["latency_attainment"] < 1.0
+    assert status["latency_p95_ms"] == pytest.approx(500.0)
+    assert status["scale_hint"] == SCALE_UP
+
+
+def test_busy_fleet_holds_instead_of_scaling_down():
+    """Healthy but well-utilized traffic (high batch occupancy) must
+    not suggest down — that is the hold state."""
+    target, monitor = make_monitor()
+    target.occupancy = (10.0, 9.0)  # mean fill 0.9
+    monitor.tick(now=1000.0)
+    target.serve(100)
+    target.occupancy = (20.0, 18.0)
+    status = monitor.tick(now=1030.0)
+    assert status["availability"] == 1.0
+    assert status["utilization"] == pytest.approx(0.9)
+    assert status["scale_hint"] == SCALE_HOLD
+
+
+def test_gauges_published_and_status_schema():
+    registry = TelemetryRegistry(enabled=True)
+    target, monitor = make_monitor(registry=registry)
+    monitor.tick(now=1000.0)
+    target.fail(5)
+    status = monitor.tick(now=1010.0)
+    # the slo.* gauge surface (docs/observability.md metric catalog)
+    gauges = registry.snapshot()["gauges"]
+    assert gauges["slo.availability"] == status["availability"]
+    assert gauges["slo.latency_attainment"] == status["latency_attainment"]
+    assert gauges["slo.burn_rate_fast"] == status["burn_rate_fast"]
+    assert gauges["slo.burn_rate_slow"] == status["burn_rate_slow"]
+    assert gauges["slo.error_budget_remaining"] == (
+        status["error_budget_remaining"]
+    )
+    assert gauges["slo.scale_hint"] == 1.0  # up
+    # the machine-readable record shape (harness + /healthz block)
+    assert set(status) >= {
+        "objectives", "window_s", "fast_window_s", "samples",
+        "availability", "availability_fast", "latency_attainment",
+        "latency_p95_ms", "burn_rate_fast", "burn_rate_slow",
+        "error_budget_remaining", "backlog", "backlog_frac",
+        "utilization", "scale_hint",
+    }
+    # status() returns the same evaluation
+    assert monitor.status() == status
+
+
+def test_ring_is_bounded_by_the_slow_window():
+    target, monitor = make_monitor(interval_s=5.0, window_s=300.0)
+    for i in range(200):
+        monitor.tick(now=1000.0 + 5.0 * i)
+    # samples older than window + 2*interval are dropped
+    assert monitor.status()["samples"] <= 300.0 / 5.0 + 3
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="availability_objective"):
+        SLOConfig(availability_objective=1.0)
+    with pytest.raises(ValueError, match="fast_window_s"):
+        SLOConfig(fast_window_s=600.0, window_s=300.0)
+
+
+def test_worker_thread_ticks_and_stops():
+    """start=True samples on the interval without the test driving it;
+    stop() joins the worker."""
+    target = _StubTarget()
+    monitor = SLOMonitor(
+        target,
+        registry=TelemetryRegistry(enabled=True),
+        config=SLOConfig(interval_s=0.05),
+        start=True,
+    )
+    import time as _time
+
+    deadline = _time.monotonic() + 5
+    while _time.monotonic() < deadline and monitor.status()["samples"] < 2:
+        _time.sleep(0.02)
+    assert monitor.status()["samples"] >= 2
+    monitor.stop()
+    assert not monitor._thread.is_alive()
+
+
+def test_capacity_inferred_from_service_and_fleet():
+    from memvul_tpu.serving.slo import _infer_capacity
+
+    class _Cfg:
+        max_queue = 64
+
+    class _Svc:
+        config = _Cfg()
+
+    class _Replica:
+        service = _Svc()
+
+    class _Router:
+        replicas = [_Replica(), _Replica()]
+
+    assert _infer_capacity(_Svc()) == 64
+    assert _infer_capacity(_Router()) == 128
+    assert _infer_capacity(object()) == 256
+
+
+def test_availability_clamped_when_inflight_resolves_inside_window():
+    """A request admitted before the window's base sample but resolved
+    inside it makes served_Δ > requests_Δ; availability clamps at 1.0
+    instead of reporting >100% (found by a live serve drive)."""
+    target, monitor = make_monitor()
+    target.counters["serve.requests"] += 3  # in flight at the base sample
+    monitor.tick(now=1000.0)
+    target.counters["serve.served"] += 3    # they resolve inside the window
+    target.serve(10)
+    status = monitor.tick(now=1010.0)
+    assert status["availability"] == 1.0
+    assert status["availability_fast"] == 1.0
+    assert status["burn_rate_fast"] == 0.0

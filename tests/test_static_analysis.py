@@ -661,3 +661,41 @@ def test_bench_lint_record_is_parseable():
         "metric", "clean", "findings", "suppressed", "baselined",
         "files", "elapsed_s",
     }
+
+
+def test_observability_endpoints_snapshot_only_known_bad(tmp_path):
+    """The /metrics//tracez//profilez discipline (PR 10): a future
+    handler that scores, packs, or rolls a bank inline — instead of
+    reading snapshots — fails MV102.  One known-bad handler per
+    forbidden family; the snapshot-reading twin stays clean."""
+    _write_tree(tmp_path, {
+        "pkg/bad_endpoints.py": (
+            "from http.server import BaseHTTPRequestHandler\n"
+            "class MetricsHandler(BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        if self.path == '/metrics':\n"
+            "            self.server.service.predict_file('corpus')\n"
+            "        elif self.path == '/tracez':\n"
+            "            pack_token_budget([1, 2], 8, 4)\n"
+            "    def do_POST(self):\n"
+            "        rolling_swap(self.server.router, [])\n"
+        ),
+        "pkg/good_endpoints.py": (
+            "from http.server import BaseHTTPRequestHandler\n"
+            "class SnapshotHandler(BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        parts = self.server.service.metrics_snapshots()\n"
+            "        ring = self.server.service.recent_traces(10)\n"
+            "        slo = self.server.monitor.status()\n"
+            "        return parts, ring, slo\n"
+        ),
+    })
+    result = _analyze_fixture(tmp_path, select=["MV102"])
+    hits = sorted(
+        (f.path, f.line, f.symbol) for f in result.active
+    )
+    assert hits == [
+        ("pkg/bad_endpoints.py", 5, "predict_file"),
+        ("pkg/bad_endpoints.py", 7, "pack_token_budget"),
+        ("pkg/bad_endpoints.py", 9, "rolling_swap"),
+    ], hits

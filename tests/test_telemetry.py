@@ -505,3 +505,198 @@ def test_report_replica_dirs_without_router_events(tmp_path):
     assert "REPLICAS" in text
     assert "served=7" in text
     assert "restarts=3" in text
+
+
+# -- Prometheus exposition (telemetry/exposition.py, PR 10) --------------------
+
+def test_exposition_renders_and_parses_exactly():
+    """Counters/gauges map 1:1, histograms render as summaries, and
+    parse_exposition round-trips every value the snapshot holds."""
+    from memvul_tpu.telemetry.exposition import (
+        parse_exposition, render_exposition, sanitize_metric_name,
+    )
+
+    registry = TelemetryRegistry(enabled=True)
+    registry.counter("serve.requests").inc(7)
+    registry.counter("bank.anchor_wins.CWE-79").inc(2)  # dashed suffix
+    registry.gauge("serve.queue_depth").set(3.5)
+    for v in (0.1, 0.2, 0.3, 0.4):
+        registry.histogram("serve.latency_s").observe(v)
+    snapshot = registry.snapshot()
+    text = render_exposition([({}, snapshot)])
+    parsed = parse_exposition(text)  # raises on any malformed line
+    assert parsed["serve_requests"][""] == 7
+    assert parsed[sanitize_metric_name("bank.anchor_wins.CWE-79")][""] == 2
+    assert parsed["serve_queue_depth"][""] == 3.5
+    assert parsed["serve_latency_s_count"][""] == 4
+    assert abs(parsed["serve_latency_s_sum"][""] - 1.0) < 1e-9
+    assert parsed["serve_latency_s"]['{quantile="0.5"}'] == (
+        snapshot["histograms"]["serve.latency_s"]["p50"]
+    )
+    # TYPE comment lines are present and well-formed
+    types = {
+        line.split()[2]: line.split()[3]
+        for line in text.splitlines() if line.startswith("# TYPE")
+    }
+    assert types["serve_requests"] == "counter"
+    assert types["serve_queue_depth"] == "gauge"
+    assert types["serve_latency_s"] == "summary"
+
+
+def test_exposition_labels_escape_and_group_by_metric():
+    from memvul_tpu.telemetry.exposition import (
+        parse_exposition, render_exposition,
+    )
+
+    a = TelemetryRegistry(enabled=True)
+    b = TelemetryRegistry(enabled=True)
+    a.counter("serve.served").inc(1)
+    b.counter("serve.served").inc(2)
+    text = render_exposition([
+        ({"replica": "replica-0"}, a.snapshot()),
+        ({"replica": 'we"ird\nname'}, b.snapshot()),
+    ])
+    # one TYPE line even with two labeled parts
+    assert text.count("# TYPE serve_served counter") == 1
+    parsed = parse_exposition(text)
+    assert parsed["serve_served"]['{replica="replica-0"}'] == 1
+    weird = [k for k in parsed["serve_served"] if "ird" in k]
+    assert weird and '\\n' in weird[0] and '\\"' in weird[0]
+
+
+def test_exposition_empty_snapshot_renders_empty():
+    from memvul_tpu.telemetry.exposition import (
+        parse_exposition, render_exposition,
+    )
+
+    assert parse_exposition(
+        render_exposition([({}, {"counters": {}, "gauges": {}, "histograms": {}})])
+    ) == {}
+    with pytest.raises(ValueError, match="not a Prometheus sample"):
+        parse_exposition("this is { not a metric")
+
+
+# -- torn-tail tolerance under a LIVE concurrent writer ------------------------
+
+def test_read_jsonl_tolerates_live_concurrent_writer(tmp_path):
+    """read_jsonl under an actively appending writer thread: every
+    parse attempt succeeds, parsed records are only ever whole lines,
+    the count never goes backwards, and at most the torn tail is
+    skipped — the live twin of the pre-truncated-tail test above."""
+    import threading
+    import time as _time
+
+    path = tmp_path / "events.jsonl"
+    n_lines = 300
+    stop = threading.Event()
+
+    def writer():
+        # a real JsonlSink writes whole flushed lines; tear windows are
+        # made visible by flushing half a record first, like a SIGKILL
+        # (or a scraper) catching the file mid-append
+        with open(path, "a", encoding="utf-8") as f:
+            for i in range(n_lines):
+                line = json.dumps({"kind": "tick", "i": i})
+                half = len(line) // 2
+                f.write(line[:half])
+                f.flush()  # a reader here sees a torn tail
+                _time.sleep(0.0005)  # hold the tear open for the race
+                f.write(line[half:] + "\n")
+                f.flush()
+        stop.set()
+
+    thread = threading.Thread(target=writer)
+    thread.start()
+    seen = 0
+    reads = 0
+    try:
+        while not stop.is_set():
+            records, skipped = read_jsonl(path)  # must never raise
+            reads += 1
+            assert skipped <= 1, "only the in-flight tail may be torn"
+            for record in records:
+                assert record["kind"] == "tick"  # no partial objects
+            assert [r["i"] for r in records] == list(range(len(records)))
+            assert len(records) >= seen, "parsed count went backwards"
+            seen = len(records)
+    finally:
+        thread.join(timeout=10)
+    records, skipped = read_jsonl(path)
+    assert len(records) == n_lines and skipped == 0
+    assert reads > 10, "the reader never actually raced the writer"
+
+
+# -- machine-readable report (telemetry-report --json, PR 10) ------------------
+
+_REPORT_JSON_KEYS = {
+    "schema", "run_dir", "generated_wall", "events", "heartbeat", "spans",
+    "counters", "gauges", "histograms", "derived", "latency_decomposition",
+    "replicas",
+}
+
+
+def _traced_serve_run(tmp_path):
+    """A run dir with serve counters + the stage histograms + one span."""
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    registry.counter("serve.requests").inc(10)
+    registry.counter("serve.served").inc(10)
+    registry.counter("serve.tokens_real").inc(30)
+    registry.counter("serve.tokens_padded").inc(60)
+    for v in (0.004, 0.006):
+        registry.histogram("serve.queue_wait_s").observe(v)
+        registry.histogram("serve.pack_s").observe(v / 2)
+        registry.histogram("serve.device_s").observe(v * 3)
+        registry.histogram("serve.resolve_s").observe(v / 4)
+    with registry.span("serve_warmup"):
+        pass
+    registry.event("rtrace", trace_id="x-1", cause="ok")
+    registry.close()
+    return tmp_path / "run"
+
+
+def test_report_json_schema_pinned(tmp_path):
+    from memvul_tpu.telemetry.report import report_json
+
+    run_dir = _traced_serve_run(tmp_path)
+    report = report_json(run_dir)
+    assert set(report) == _REPORT_JSON_KEYS  # the pinned schema
+    assert report["schema"] == 1
+    assert report["events"]["parsed"] > 0
+    assert report["events"]["skipped"] == 0
+    assert report["counters"]["serve.served"] == 10
+    assert report["derived"]["serve.real_token_utilization"] == 0.5
+    assert report["spans"]["serve_warmup"]["count"] == 1
+    assert report["heartbeat"]["age_s"] >= 0
+    decomposition = report["latency_decomposition"]
+    assert set(decomposition) == {"queue_wait", "pack", "device", "resolve"}
+    assert sum(r["share"] for r in decomposition.values()) == pytest.approx(1.0)
+    assert decomposition["device"]["count"] == 2
+    # stable under json round-trip (the CI-consumption contract)
+    assert json.loads(json.dumps(report, default=str))["schema"] == 1
+    # a bare dir still reports, with the same schema
+    empty = report_json(tmp_path)
+    assert set(empty) == _REPORT_JSON_KEYS
+    assert empty["heartbeat"] is None
+    assert empty["latency_decomposition"] == {}
+
+
+def test_report_json_cli_and_text_decomposition(tmp_path, capsys):
+    from memvul_tpu.__main__ import main
+
+    run_dir = _traced_serve_run(tmp_path)
+    assert main(["telemetry-report", str(run_dir), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert set(payload) == _REPORT_JSON_KEYS
+    assert payload["counters"]["serve.requests"] == 10
+    # the text report gains the latency-decomposition section
+    assert main(["telemetry-report", str(run_dir)]) == 0
+    text = capsys.readouterr().out
+    assert "LATENCY DECOMPOSITION" in text
+    for stage in ("queue_wait", "pack", "device", "resolve"):
+        assert stage in text
+    # and a run without stage histograms renders no such section
+    other = telemetry.configure(run_dir=tmp_path / "plain")
+    other.counter("train.steps").inc(1)
+    other.close()
+    assert main(["telemetry-report", str(tmp_path / "plain")]) == 0
+    assert "LATENCY DECOMPOSITION" not in capsys.readouterr().out
