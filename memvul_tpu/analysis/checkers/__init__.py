@@ -8,7 +8,8 @@ registry (``engine.CHECKERS``); each module is one family:
 * :mod:`.artifacts` — MV103 artifact-write hygiene (generalized bankops lint)
 * :mod:`.purity`    — MV201 trace purity (host effects in jitted code)
 * :mod:`.locks`     — MV301/302/303 lock discipline in threaded classes
-* :mod:`.drift`     — MV401–404 registry drift (faults / metrics / config)
+* :mod:`.drift`     — MV401–405 registry drift (faults / metrics /
+  config / compile-chokepoint)
 """
 
 from . import artifacts, drift, handlers, locks, prints, purity  # noqa: F401

@@ -1,9 +1,9 @@
-"""MV401–MV404 — cross-file registry drift.
+"""MV401–MV405 — cross-file registry drift.
 
 The repo keeps several name registries that code, tests and docs must
 agree on; nothing enforced that agreement until now, so it drifted
 (PR 5–8 added counters the observability doc never learned about).
-Four checkers, all over the one shared parse:
+Five checkers, all over the one shared parse:
 
 * **MV401 unregistered-fault-point** — every fault point named in a
   ``MEMVUL_FAULTS`` spec (tests/docs) or passed to ``fault_point()``
@@ -26,6 +26,12 @@ Four checkers, all over the one shared parse:
   access on a variable assigned from a ``config.*_config()`` section
   reader must resolve against the matching ``config.*_DEFAULTS`` dict;
   a typo'd key otherwise silently reads the default forever.
+* **MV405 registry-bypass-compile** — every ``.lower(...).compile(``
+  chain outside ``telemetry/programs.py`` bypasses the compiled-program
+  registry's ``compile_and_register`` chokepoint, so the executable is
+  invisible to ``/programz``, the ``xla.*`` gauges and the roofline
+  report.  Pass the lowered object to ``compile_and_register`` instead
+  (an intentionally-raw compile carries a justified inline disable).
 """
 
 from __future__ import annotations
@@ -414,3 +420,41 @@ def check_config_keys(ctx: AnalysisContext) -> Iterator[Finding]:
                     "reads the default forever",
                     symbol=key,
                 )
+
+
+# -- MV405: raw .lower().compile() outside the program registry ----------------
+
+# the one sanctioned compile site: ProgramRegistry.compile_and_register
+_COMPILE_CHOKEPOINT = "telemetry/programs.py"
+
+
+@register(
+    "MV405",
+    "registry-bypass-compile",
+    ".lower(...).compile() outside telemetry/programs.py bypasses the "
+    "program registry",
+)
+def check_registry_bypass_compile(ctx: AnalysisContext) -> Iterator[Finding]:
+    for pf in ctx.files:
+        if pf.tree is None or ctx.rel_to_root(pf) == _COMPILE_CHOKEPOINT:
+            continue
+        for node in ast.walk(pf.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "compile"
+                and isinstance(node.func.value, ast.Call)
+                and isinstance(node.func.value.func, ast.Attribute)
+                and node.func.value.func.attr == "lower"
+            ):
+                continue
+            yield Finding(
+                "MV405", pf.rel, node.lineno,
+                "raw .lower(...).compile() bypasses the compiled-program "
+                "registry — pass the lowered object to "
+                "ProgramRegistry.compile_and_register so the executable "
+                "shows up in /programz, the xla.* metrics and the "
+                "roofline report (lint: disable=MV405 with a "
+                "justification if a raw compile is intentional)",
+                symbol="lower().compile()",
+            )

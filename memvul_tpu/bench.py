@@ -106,6 +106,45 @@ def _metric_name() -> str:
     return f"{micro}_microbench" if micro else "siamese_scoring_throughput"
 
 
+def _program_blocks() -> dict:
+    """Per-program compile/cost rows + the roofline summary for a bench
+    record (telemetry/programs.py).  Off-TPU the rows still carry
+    analyzed FLOPs/compile times with ``interpret_only`` set, so a CPU
+    smoke run and a TPU run emit the same record shape.  Empty when the
+    bench path registered nothing (keeps old record shapes intact)."""
+    from memvul_tpu.telemetry.programs import get_program_registry
+
+    registry = get_program_registry()
+    programs = registry.snapshot()
+    if not programs:
+        return {}
+    roof = registry.roofline()
+    return {
+        "programs": [
+            {
+                "key": p["key"],
+                "scope": p["scope"],
+                "compile_s": p["compile_s"],
+                "flops": p["flops"],
+                "bytes_accessed": p["bytes_accessed"],
+                "hbm_bytes": p["hbm_bytes"],
+                "invocations": p["invocations"],
+                "device_time_s": p["device_time_s"],
+                "mfu": p["mfu"],
+            }
+            for p in programs
+        ],
+        "xla": {
+            "device_kind": roof["device_kind"],
+            "interpret_only": roof["interpret_only"],
+            "mfu": roof["mfu"],
+            "membw_util": roof["membw_util"],
+            "flops_total": roof["flops_total"],
+            "device_time_s": roof["device_time_s"],
+        },
+    }
+
+
 class _PhaseWatchdog:
     """Hard per-phase deadline inside the bench child.
 
@@ -166,6 +205,19 @@ class _PhaseWatchdog:
             "watchdog_timeout": True,
             "heartbeat_age_s": round(age, 1),
         }
+        # program-registry attribution: a recent compile with a small
+        # age means the phase is wedged INSIDE (or right after) that
+        # key's kernel.lower/compile; no compiles at all means the hang
+        # predates the first program — different bugs, same rc=124
+        try:
+            from memvul_tpu.telemetry.programs import get_program_registry
+
+            last = get_program_registry().last_compile()
+            if last is not None:
+                record["last_compile_key"] = last["key"]
+                record["last_compile_age_s"] = round(last["age_s"], 1)
+        except Exception:  # the failure record must always emit
+            pass
         sys.stdout.write(json.dumps(record) + "\n")
         sys.stdout.flush()
         sys.stderr.write(
@@ -381,6 +433,7 @@ def _run_bench() -> None:
                     "quant": quant,
                     "inflight": inflight,
                 },
+                **_program_blocks(),
             }
         )
     )
@@ -617,6 +670,7 @@ def _run_train_step_micro() -> None:
                     "grad_accum": accum,
                     "steps_per_epoch": steps,
                 },
+                **_program_blocks(),
             }
         )
     )
@@ -859,6 +913,7 @@ def _run_serve_micro() -> None:
             "impl_mode": impl_mode,
             "token_budget": token_budget,
         },
+        **_program_blocks(),
     }
     if impl_mode == "ab":
         by_impl = {leg["impl"]: leg for leg in records}

@@ -228,6 +228,12 @@ def train_from_config(
         heartbeat_every_s=float(tel_cfg["heartbeat_every_s"]),
         step_events=bool(tel_cfg["step_events"]),
     )
+    # opt-in live scrape surface for the (multi-hour) run: /metrics +
+    # /programz on a daemon thread; 0 (the default) constructs nothing
+    metrics_port = int(tel_cfg["metrics_port"] or 0)
+    metrics_server = (
+        telemetry.start_metrics_server(metrics_port) if metrics_port else None
+    )
 
     seed = int(config.get("random_seed", 2021))
     tokenizer = build_tokenizer(config.get("tokenizer"))
@@ -303,8 +309,15 @@ def train_from_config(
         )
     finally:
         # final heartbeat + telemetry.json rollup, even on a crash — the
-        # post-mortem is exactly when the summary matters
+        # post-mortem is exactly when the summary matters.  The program
+        # table lands beside the sinks (telemetry-report's PROGRAMS
+        # section), and a SIGTERM-preempted run unwinds through here
+        # too, so the exposition port always releases cleanly.
+        if tel.enabled:
+            telemetry.write_programs(serialization_dir)
         tel.close()
+        if metrics_server is not None:
+            metrics_server.close()
     result["archive"] = str(serialization_dir / ARCHIVE_NAME)
     return result
 
@@ -537,6 +550,12 @@ def serve_from_archive(
                 score_impl=score_impl,
                 token_budget=token_budget,
                 max_rows_per_pack=max_rows_per_pack,
+                # replica-private program registry, bound to the
+                # replica's telemetry: /programz fan-out and per-replica
+                # xla.* rows stay attributable to one device
+                program_registry=telemetry.ProgramRegistry(
+                    telemetry=registry
+                ),
             )
             predictor.encode_anchors(anchors)
             return ScoringService(
@@ -630,6 +649,12 @@ def evaluate_from_archive(
         events=bool(tel_cfg["events"]),
         heartbeat_every_s=float(tel_cfg["heartbeat_every_s"]),
         step_events=bool(tel_cfg["step_events"]),
+    )
+    # live scrape surface for the corpus pass (predict_file's rows/s,
+    # journal lag, program table) — opt-in, default off
+    metrics_port = int(tel_cfg["metrics_port"] or 0)
+    metrics_server = (
+        telemetry.start_metrics_server(metrics_port) if metrics_port else None
     )
     model_cfg = arch.config.get("model") or {}
     model_type = model_cfg.get("type", "model_memory")
@@ -736,4 +761,8 @@ def evaluate_from_archive(
                 inflight=inflight,
             )
     finally:
+        if tel.enabled:
+            telemetry.write_programs(out_dir)
         tel.close()
+        if metrics_server is not None:
+            metrics_server.close()

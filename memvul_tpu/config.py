@@ -409,6 +409,12 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
     # serve.hbm_in_use_bytes / serve.hbm_peak_bytes per replica at
     # heartbeat cadence (no-op on backends without memory stats)
     "hbm_gauges": True,
+    # live exposition for non-serving runs (telemetry/live.py): a
+    # daemon-thread /metrics + /programz server inside train_from_config
+    # and the corpus-eval predict_file flow.  0 (default) = off — the
+    # run's emitted metric/event set stays identical to a build without
+    # the server; any other value binds that port (0 < p < 65536)
+    "metrics_port": 0,
 }
 
 

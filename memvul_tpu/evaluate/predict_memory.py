@@ -41,6 +41,7 @@ from ..resilience import faults
 from ..resilience.journal import DeadLetter, ScoreJournal
 from ..resilience.retry import RetryPolicy, exception_text
 from ..telemetry import get_registry
+from ..telemetry.programs import get_program_registry
 from ..training.metrics import SiameseMeasure
 from .measure import cal_metrics
 
@@ -64,10 +65,22 @@ class SiamesePredictor:
         score_impl: str = "bucketed",
         token_budget: Optional[int] = None,
         max_rows_per_pack: Optional[int] = None,
+        program_registry=None,
     ) -> None:
         self.model = model
         self.mesh = mesh
         self.batch_size = batch_size
+        # every lower().compile() routes through this registry's
+        # chokepoint (telemetry/programs.py; checker MV405) — replica
+        # factories pass their own instance, everything else shares the
+        # process-wide one
+        self.programs = (
+            program_registry if program_registry is not None
+            else get_program_registry()
+        )
+        # a fresh predictor has warmed nothing yet: re-traces before its
+        # warmup completes are expected, not recompile regressions
+        self.programs.mark_warm("score", warm=False)
         self.anchor_chunk = anchor_chunk
         self.encoder = CachedEncoder(tokenizer, max_length=max_length)
         self.buckets = validate_buckets(buckets, max_length) if buckets else None
@@ -143,6 +156,9 @@ class SiamesePredictor:
 
         def _score(p, b, bank):
             self.score_trace_count += 1  # host-side, runs at trace only
+            self.programs.note_trace(
+                "score", self.bucket_program_key(*b["input_ids"].shape)
+            )
             return anchor_probs(
                 self.model.apply(
                     p, b, anchors=bank, deterministic=True,
@@ -154,6 +170,7 @@ class SiamesePredictor:
 
         def _score_ragged(p, sample, bank):
             self.score_trace_count += 1  # host-side, runs at trace only
+            self.programs.note_trace("score", self.ragged_program_key())
             return anchor_probs(
                 self.model.apply(
                     p, sample, bank, deterministic=True,
@@ -291,6 +308,19 @@ class SiamesePredictor:
             raise RuntimeError("call encode_anchors() first")
         return self.warmup_bank_shapes(self.anchor_bank)
 
+    def bucket_program_key(self, rows: int, length: int) -> str:
+        """Program-registry key for one bucketed score shape — shared
+        between warmup registration, trace attribution, and the serving
+        tier's per-dispatch invocation accounting."""
+        return f"score:{rows}x{length}"
+
+    def ragged_program_key(self) -> str:
+        """Program-registry key for the single ragged score program."""
+        return (
+            f"score_ragged:budget={self.token_budget}"
+            f",rows={self.max_rows_per_pack}"
+        )
+
     def ragged_shape(self) -> Tuple[int, int]:
         """The single (token_budget, max_rows) geometry the ragged score
         program compiles at — every pack dispatches this one shape."""
@@ -317,19 +347,28 @@ class SiamesePredictor:
         length mix — instead of the per-bucket grid
         (docs/ragged_serving.md).  The bucketed ``score_instances``
         path on such a predictor still works but compiles lazily."""
+        # warmup (or a hot-swap re-warmup) legitimately traces: unlatch
+        # the warm flag so those traces don't read as recompiles, then
+        # re-latch once every warmed shape is registered
+        self.programs.mark_warm("score", warm=False)
         if self.score_impl == "ragged":
             start = time.perf_counter()
             tel = get_registry()
             with tel.span("aot_warmup", shapes=1):
                 tel.progress()
                 try:
-                    self._ragged_score_fn.lower(
-                        self.params, self._ragged_warm_sample(), bank
-                    ).compile()
+                    self.programs.compile_and_register(
+                        self.ragged_program_key(),
+                        self._ragged_score_fn.lower(
+                            self.params, self._ragged_warm_sample(), bank
+                        ),
+                        scope="score",
+                    )
                 except Exception as e:
                     if not self._maybe_degrade_to_xla(e):
                         raise
                     return self.warmup_bank_shapes(bank)
+            self.programs.mark_warm("score")
             logger.info(
                 "AOT warmup: 1 ragged score program (budget=%d, max_rows=%d) "
                 "compiled in %.1fs — replaces the bucket grid",
@@ -350,7 +389,11 @@ class SiamesePredictor:
                 if self.mesh is not None:
                     sample = shard_batch(sample, self.mesh)
                 try:
-                    self._score_fn.lower(self.params, sample, bank).compile()
+                    self.programs.compile_and_register(
+                        self.bucket_program_key(rows, length),
+                        self._score_fn.lower(self.params, sample, bank),
+                        scope="score",
+                    )
                 except Exception as e:
                     if not self._maybe_degrade_to_xla(e):
                         raise
@@ -358,6 +401,7 @@ class SiamesePredictor:
                     # compiled on the fused one — restart the warmup so
                     # the zero-mid-stream-compile contract still holds
                     return self.warmup_bank_shapes(bank)
+        self.programs.mark_warm("score")
         logger.info(
             "AOT warmup: %d score program(s) %s compiled in %.1fs",
             len(shapes), shapes, time.perf_counter() - start,
@@ -468,6 +512,12 @@ class SiamesePredictor:
             occupancy_hist.observe(len(metas) / max(1, arr.shape[0]))
             batches_ctr.inc()
             rows_ctr.inc(len(metas))
+            # count-only attribution: dispatch is async, so per-call
+            # device time isn't observable here — the sync-to-sync
+            # latency histogram above carries the timing story
+            self.programs.record_invocation(
+                self.bucket_program_key(*batch["sample1"]["input_ids"].shape)
+            )
             tel.progress()
             # drop dead rows and any zero-padded anchor columns
             sliced = arr[: len(metas), : self.n_anchors]

@@ -30,6 +30,7 @@ from ..data.batching import (
 )
 from ..data.readers import DatasetReader, SingleReader
 from ..parallel.mesh import create_mesh, replicate, shard_batch
+from ..telemetry.programs import get_program_registry
 from ..training.metrics import model_measure
 
 logger = logging.getLogger(__name__)
@@ -53,9 +54,17 @@ class _ProbsProgram:
 
     def __init__(self, model) -> None:
         self.trace_count = 0
+        # program-registry keys already registered through the shared
+        # program — a later predictor's warmup skips these outright, so
+        # sharing never shows up as a recompile
+        self.warmed_keys: set = set()
+        get_program_registry().mark_warm("probs", warm=False)
 
         def _probs(p, b):
             self.trace_count += 1  # host-side, runs at trace only
+            get_program_registry().note_trace(
+                "probs", "probs:{}x{}".format(*b["input_ids"].shape)
+            )
             return jax.nn.softmax(
                 model.apply(p, b, deterministic=True).astype(np.float32), axis=-1
             )
@@ -127,14 +136,29 @@ class SinglePredictor:
         in the shared program's jit cache; a later predictor over the
         same model skips even this warmup)."""
         shapes = self.stream_shapes()
-        for rows, length in shapes:
+        programs = get_program_registry()
+        fresh = [
+            (rows, length)
+            for rows, length in shapes
+            if f"probs:{rows}x{length}" not in self._program.warmed_keys
+        ]
+        if fresh:
+            # warming genuinely-new shapes traces; unlatch the warm flag
+            # so those traces don't read as recompile regressions
+            programs.mark_warm("probs", warm=False)
+        for rows, length in fresh:
             sample = {
                 "input_ids": np.zeros((rows, length), np.int32),
                 "attention_mask": np.ones((rows, length), np.int32),
             }
             if self.mesh is not None:
                 sample = shard_batch(sample, self.mesh)
-            self._probs_fn.lower(self.params, sample).compile()
+            key = f"probs:{rows}x{length}"
+            programs.compile_and_register(
+                key, self._probs_fn.lower(self.params, sample), scope="probs"
+            )
+            self._program.warmed_keys.add(key)
+        programs.mark_warm("probs")
         return len(shapes)
 
     def predict_file(
@@ -194,10 +218,16 @@ class SinglePredictor:
             n += len(metas)
             f.write(json.dumps(records) + "\n")
 
+        programs = get_program_registry()
         with open(out_path, "w") as f:
             for dev, batch in inflight_pipeline(
                 prefetch(batches), dispatch, inflight=inflight
             ):
+                # count-only: the dispatch is asynchronous, so per-call
+                # device time isn't observable at this drain point
+                programs.record_invocation(
+                    "probs:{}x{}".format(*batch["sample1"]["input_ids"].shape)
+                )
                 _drain(dev, batch["meta"], f)
         elapsed = time.perf_counter() - start
         logger.info(

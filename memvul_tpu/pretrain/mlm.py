@@ -305,6 +305,26 @@ class MLMTrainer:
         self._train_step = jit_step(
             train_step, donate=(0, 1, 2), debug_checks=self.c.debug_checks
         )
+        from ..telemetry.programs import get_program_registry
+
+        self._programs = get_program_registry()
+        self._step_shapes: set = set()
+
+    def _register_step_program(self, *args) -> str:
+        """First occurrence of a stack shape routes through the program
+        registry's chokepoint (see MemoryTrainer._register_step_program)."""
+        from ..telemetry.programs import shape_key
+
+        key = shape_key("mlm_step", args[3:])
+        if key in self._step_shapes:
+            return key
+        self._step_shapes.add(key)
+        lower = getattr(self._train_step, "lower", None)
+        if lower is not None:
+            self._programs.compile_and_register(
+                key, lower(*args), scope="mlm"
+            )
+        return key
 
     # -- checkpoint / resume --------------------------------------------------
 
@@ -511,11 +531,15 @@ class MLMTrainer:
             for i, (ids, mask, labels) in enumerate(batches):
                 if c.steps_per_epoch is not None and i >= c.steps_per_epoch:
                     break
+                program_key = self._register_step_program(
+                    self.params, self.opt_state, rng, ids, mask, labels
+                )
                 self.params, self.opt_state, rng, loss = self._train_step(
                     self.params, self.opt_state, rng, ids, mask, labels
                 )
                 pending.append(loss)
                 self.step += 1
+                self._programs.record_invocation(program_key)
                 if len(pending) >= max(1, c.sync_every):
                     drain()
             drain()

@@ -27,6 +27,10 @@ table):
   ``replica`` labels).
 * ``GET /tracez[?limit=N]`` → the bounded ring of recent completed
   request traces, newest first (serving/service.py tracing).
+* ``GET /programz`` → the compiled-program registry rows, newest
+  compile first, plus the aggregate roofline reading
+  (telemetry/programs.py; a router target merges every replica's rows
+  with ``replica`` stamps).
 * ``POST /profilez`` with ``{"seconds": N}`` → starts an on-demand
   ``jax.profiler`` capture into the run dir while traffic keeps
   flowing; 409 while one is already running, 503 when the server was
@@ -159,6 +163,17 @@ class ScoreHandler(BaseHTTPRequestHandler):
             traces = service.recent_traces(limit)
             self._reply(200, {"count": len(traces), "traces": traces})
             return
+        if path == "/programz":
+            # compiled-program registry rows, newest compile first — a
+            # snapshot read like /metrics (a router target fans out per
+            # replica, each row stamped with its replica name)
+            programs = service.programs_snapshot()
+            payload = {"count": len(programs), "programs": programs}
+            roofline = getattr(service, "programs_roofline", None)
+            if roofline is not None:
+                payload["roofline"] = roofline()
+            self._reply(200, payload)
+            return
         self._reply(404, {"status": "error", "reason": "unknown path"})
 
     def _do_profilez(self) -> None:
@@ -255,7 +270,8 @@ def run_http_server(
         thread.start()
     logger.info(
         "scoring service listening on http://%s:%d (POST /score, GET "
-        "/healthz, GET /metrics, GET /tracez, POST /profilez)",
+        "/healthz, GET /metrics, GET /tracez, GET /programz, "
+        "POST /profilez)",
         *server.server_address[:2],
     )
     return server

@@ -212,7 +212,29 @@ class ReplicaRouter:
         parts: List = [({}, self._tel.snapshot())]
         for replica in self.replicas:
             parts.append(({"replica": replica.name}, replica.registry.snapshot()))
+            service = replica.service
+            if service is not None:
+                programs = getattr(service.predictor, "programs", None)
+                part = programs.metrics_part() if programs is not None else {}
+                if part:
+                    parts.append(({"replica": replica.name}, part))
         return parts
+
+    def programs_snapshot(self) -> List[Dict[str, Any]]:
+        """Fleet ``/programz``: every replica's registered programs,
+        stamped with their replica name, merged newest-compile-first
+        (the per-row ``compiled_wall`` orders them globally)."""
+        rows: List[Dict[str, Any]] = []
+        for replica in self.replicas:
+            service = replica.service
+            if service is None:
+                continue
+            for row in service.programs_snapshot():
+                row = dict(row)
+                row["replica"] = replica.name
+                rows.append(row)
+        rows.sort(key=lambda r: -(r.get("compiled_wall") or 0.0))
+        return rows
 
     def recent_traces(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """Fleet ``/tracez``: every replica's completed-trace ring,

@@ -498,8 +498,29 @@ class ScoringService:
         """The snapshot parts ``telemetry.exposition`` renders for
         ``GET /metrics`` — one unlabeled part for a bare service; the
         router's override fans out per replica with ``replica`` labels.
-        A pure registry read (the handler contract: snapshots only)."""
-        return [({}, self._tel.snapshot())]
+        A pure registry read (the handler contract: snapshots only).
+        The predictor's program registry contributes its derived
+        ``xla.*`` rows as an extra part — additive only, so the
+        pre-registry scrape body is a strict subset."""
+        parts = [({}, self._tel.snapshot())]
+        programs = getattr(self.predictor, "programs", None)
+        if programs is not None:
+            part = programs.metrics_part()
+            if part:
+                parts.append(({}, part))
+        return parts
+
+    def programs_snapshot(self) -> List[Dict[str, Any]]:
+        """Newest-compile-first rows of the predictor's program registry
+        (the ``GET /programz`` body); empty for a predictor that
+        predates the registry."""
+        programs = getattr(self.predictor, "programs", None)
+        return programs.snapshot() if programs is not None else []
+
+    def programs_roofline(self) -> Optional[Dict[str, Any]]:
+        """The aggregate roofline reading for ``GET /programz``."""
+        programs = getattr(self.predictor, "programs", None)
+        return programs.roofline() if programs is not None else None
 
     def recent_traces(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
         """Completed request traces, newest first — the ``GET /tracez``
@@ -941,6 +962,18 @@ class ScoringService:
         tel.histogram("serve.batch_latency_s").observe(
             time.perf_counter() - start
         )
+        # program attribution: this dispatch ran one registered
+        # executable start-to-sync (np.asarray above blocks), so the
+        # elapsed window is the per-launch device time the roofline
+        # gauges divide by
+        programs = getattr(self.predictor, "programs", None)
+        if programs is not None:
+            programs.record_invocation(
+                self.predictor.ragged_program_key()
+                if self._score_impl == "ragged"
+                else self.predictor.bucket_program_key(rows, length),
+                time.perf_counter() - start,
+            )
         tel.histogram("serve.batch_occupancy").observe(
             len(chunk) / occupancy_rows
         )

@@ -25,3 +25,11 @@ from .exposition import (  # noqa: F401
     render_target,
     sanitize_metric_name,
 )
+from .programs import (  # noqa: F401
+    ProgramRecord,
+    ProgramRegistry,
+    get_program_registry,
+    shape_key,
+    write_programs,
+)
+from .live import start_metrics_server  # noqa: F401

@@ -1,0 +1,145 @@
+"""Opt-in live exposition for non-serving runs.
+
+The serving tier has had ``GET /metrics`` since PR 10, but a training
+run or the paper's 1.22M-IR corpus pass (``predict_file``) still only
+writes files — a multi-hour run is invisible until it finishes or
+dies.  ``telemetry.metrics_port`` (config.TELEMETRY_DEFAULTS, default
+0 = off) starts THIS server as a daemon thread inside
+``train_from_config`` / ``evaluate_from_archive``: the same Prometheus
+rendering the serving frontend uses, over the process-wide registries,
+so rows/s, heartbeat age, and the compiled-program table are
+scrapeable while the run is still going.
+
+Endpoints (all snapshot reads — the MV102 rule for handler threads
+holds here exactly as it does for the serving frontend):
+
+* ``GET /metrics``  — the process registry's snapshot plus the
+  ``xla.*`` program part, Prometheus text format;
+* ``GET /programz`` — the program registry's newest-compile-first rows
+  as JSON;
+* ``GET /healthz``  — phase + heartbeat age, the liveness probe.
+
+Default-off is load-bearing: with ``metrics_port`` 0 nothing here is
+constructed, imported state stays untouched, and the run's emitted
+metric/event set is pinned identical to the pre-registry baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List
+
+from .exposition import SnapshotPart, render_exposition
+from .programs import get_program_registry
+from .registry import get_registry
+
+logger = logging.getLogger(__name__)
+
+
+def live_parts() -> List[SnapshotPart]:
+    """The process-wide snapshot parts a live scrape renders: the
+    telemetry registry's metrics plus (when any program is registered)
+    the derived ``xla.*`` part."""
+    parts: List[SnapshotPart] = [({}, get_registry().snapshot())]
+    program_part = get_program_registry().metrics_part()
+    if program_part:
+        parts.append(({}, program_part))
+    return parts
+
+
+class _LiveMetricsHandler(BaseHTTPRequestHandler):
+    server_version = "memvul-telemetry/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format: str, *args) -> None:  # noqa: A002
+        logger.debug("%s %s", self.address_string(), format % args)
+
+    def _reply(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        path = self.path.partition("?")[0]
+        if path == "/metrics":
+            text = render_exposition(live_parts())
+            self._reply(
+                200, text.encode("utf-8"), "text/plain; version=0.0.4"
+            )
+            return
+        if path == "/programz":
+            programs = get_program_registry().snapshot()
+            payload = {
+                "count": len(programs),
+                "programs": programs,
+                "roofline": get_program_registry().roofline(),
+            }
+            self._reply(
+                200,
+                json.dumps(payload, default=float).encode("utf-8"),
+                "application/json",
+            )
+            return
+        if path == "/healthz":
+            tel = get_registry()
+            payload = {
+                "phase": tel.phase,
+                "heartbeat_age_s": round(tel.heartbeat_age_s(), 3),
+                "enabled": tel.enabled,
+            }
+            self._reply(
+                200, json.dumps(payload).encode("utf-8"), "application/json"
+            )
+            return
+        self._reply(
+            404,
+            json.dumps({"status": "error", "reason": "unknown path"}).encode(
+                "utf-8"
+            ),
+            "application/json",
+        )
+
+
+class LiveMetricsServer(ThreadingHTTPServer):
+    """The daemon-thread exposition server; ``close()`` is idempotent
+    and owned by the run entry point's ``finally`` — the same place
+    the telemetry registry closes, so a SIGTERM-preempted run (which
+    unwinds through that ``finally``) releases the port cleanly."""
+
+    daemon_threads = True
+
+    def __init__(self, address) -> None:
+        super().__init__(address, _LiveMetricsHandler)
+        self._thread: threading.Thread = threading.Thread(
+            target=self.serve_forever, name="memvul-metrics-http", daemon=True
+        )
+        self._closed = False
+
+    def start(self) -> "LiveMetricsServer":
+        self._thread.start()
+        logger.info(
+            "live telemetry exposition on http://%s:%d "
+            "(GET /metrics, /programz, /healthz)",
+            *self.server_address[:2],
+        )
+        return self
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.shutdown()
+        self.server_close()
+
+
+def start_metrics_server(
+    port: int, host: str = "127.0.0.1"
+) -> LiveMetricsServer:
+    """Bind and start the live exposition server (port 0 = ephemeral;
+    read the bound port off ``server.server_address``)."""
+    return LiveMetricsServer((host, port)).start()

@@ -1,0 +1,506 @@
+"""Compiled-program observability: the XLA program registry.
+
+Every ``jit(...).lower(...).compile()`` in the package goes through ONE
+chokepoint — :meth:`ProgramRegistry.compile_and_register` — so the
+process always knows *which executables exist*, what each cost to
+compile, what XLA's own ``cost_analysis()`` / ``memory_analysis()``
+say it does per launch, and how often it has been dispatched.  That is
+the data a roofline reading needs (docs/roofline_train.md): analyzed
+FLOPs and bytes per invocation against a small per-device peak-spec
+table turn raw seconds into ``xla.mfu`` and achieved-bandwidth gauges,
+live, instead of the hand-computed figure the chip-window debt item
+complains about.  Checker MV405 (analysis/checkers/drift.py) keeps the
+chokepoint honest: a raw ``.lower(...).compile(`` anywhere else in the
+package is registry-bypass drift.
+
+Design constraints, in order:
+
+* **separate state** — program records and the ``xla.*`` rows they
+  derive live in THIS registry, not in the
+  :class:`~memvul_tpu.telemetry.registry.TelemetryRegistry` metric
+  maps.  The ``xla.*`` metrics materialize only at render time
+  (:meth:`metrics_part` is merged as an extra snapshot part by the
+  exposition surfaces), so the emitted metric set of every existing
+  run/serve path is bit-identical to the pre-registry baseline and the
+  serving parity pins hold untouched;
+* **dependency-light** — no jax import at module load (device-kind
+  detection is lazy and failure-tolerant), mirroring the telemetry
+  registry's own rule;
+* **events are the diagnosis channel** — each chokepoint compile emits
+  a ``program`` event, and any *trace after warmup* (a cache miss that
+  is about to cost a mid-run compile) emits an ``rcompile`` event
+  naming the offending shape key — turning the bare
+  ``score_trace_count`` / ``train_trace_count`` assertions into
+  attributable records in ``events.jsonl``.
+
+Scopes: each compile family (``"score"``, ``"probs"``, ``"train"``)
+marks itself warm when its AOT warmup / first epoch completes
+(:meth:`mark_warm`); :meth:`note_trace` is called from the trace-time
+probe wrappers and only escalates to ``rcompile`` once its scope is
+warm, so warmup compiles stay quiet.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .registry import get_registry
+
+# Peak specs for the roofline denominators (docs/roofline_train.md):
+# dense bf16 FLOP/s and HBM bandwidth per chip, keyed by a lowercase
+# substring of jax's ``device_kind``.  Small on purpose — an unknown
+# device (and every CPU) renders as interpret-only rather than against
+# a made-up peak.
+PEAK_SPECS: Dict[str, Dict[str, float]] = {
+    "v5 lite": {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9},
+    "v5e": {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9},
+    "v5p": {"flops_per_s": 459e12, "hbm_bytes_per_s": 2765e9},
+    "v4": {"flops_per_s": 275e12, "hbm_bytes_per_s": 1228e9},
+    "v6e": {"flops_per_s": 918e12, "hbm_bytes_per_s": 1640e9},
+}
+
+
+def device_info() -> Tuple[str, str]:
+    """(platform, device_kind) of the default backend — ``("cpu",
+    "cpu")`` on hosts, never raises (the registry must work in a
+    process whose backend failed to initialize)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return str(dev.platform), str(getattr(dev, "device_kind", dev.platform))
+    except Exception:  # pragma: no cover - backend init failure
+        return "unknown", "unknown"
+
+
+def peak_spec(device_kind: str) -> Optional[Dict[str, float]]:
+    """The peak-spec row for a device kind, or None (interpret-only)."""
+    kind = device_kind.lower()
+    for marker, spec in PEAK_SPECS.items():
+        if marker in kind:
+            return spec
+    return None
+
+
+def shape_key(prefix: str, tree: Any) -> str:
+    """A compact, deterministic shape signature for a pytree of arrays
+    (or tracers — ``.shape`` is all it reads), e.g.
+    ``train_step:2x32x128,2x32x256``.  Used as the registry key for
+    programs whose compiled shape set is data-dependent (the trainers'
+    bucketed stack grid)."""
+    import jax
+
+    shapes = sorted({
+        "x".join(str(d) for d in leaf.shape)
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if getattr(leaf, "shape", None)
+    })
+    return f"{prefix}:{','.join(shapes)}" if shapes else prefix
+
+
+def _cost_analysis(executable) -> Dict[str, float]:
+    """``executable.cost_analysis()`` defensively: the return shape has
+    drifted across jax versions (dict vs list-of-dict) and some
+    backends raise — the registry records zeros rather than breaking a
+    compile that already succeeded."""
+    try:
+        cost = executable.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for k, v in cost.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def _memory_analysis(executable) -> Dict[str, int]:
+    """argument/output/temp HBM bytes from ``memory_analysis()``;
+    empty when the backend does not implement it (CPU)."""
+    try:
+        mem = executable.memory_analysis()
+    except Exception:
+        return {}
+    out: Dict[str, int] = {}
+    for name, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+    ):
+        value = getattr(mem, attr, None)
+        if value is None and isinstance(mem, dict):
+            value = mem.get(attr)
+        try:
+            if value is not None:
+                out[name] = int(value)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+@dataclass
+class ProgramRecord:
+    """One registered executable (one compiled shape signature)."""
+
+    key: str
+    scope: str
+    compile_s: float
+    compiled_wall: float
+    compiled_monotonic: float
+    platform: str
+    device_kind: str
+    interpret_only: bool
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    invocations: int = 0
+    device_time_s: float = 0.0
+    recompiles: int = 0
+    compile_times: List[float] = field(default_factory=list)
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.argument_bytes + self.output_bytes + self.temp_bytes
+
+    def as_dict(self, peak: Optional[Dict[str, float]]) -> Dict[str, Any]:
+        mfu = None
+        if (
+            peak is not None
+            and self.device_time_s > 0
+            and self.flops > 0
+        ):
+            mfu = (self.flops * self.invocations / self.device_time_s) / peak[
+                "flops_per_s"
+            ]
+        return {
+            "key": self.key,
+            "scope": self.scope,
+            "compile_s": round(self.compile_s, 6),
+            "compiled_wall": self.compiled_wall,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "hbm_bytes": self.hbm_bytes,
+            "invocations": self.invocations,
+            "device_time_s": round(self.device_time_s, 6),
+            "recompiles": self.recompiles,
+            "platform": self.platform,
+            "device_kind": self.device_kind,
+            "interpret_only": self.interpret_only,
+            "mfu": mfu,
+        }
+
+
+class ProgramRegistry:
+    """Thread-safe record of every compiled executable in the process
+    (or, behind a replica factory, one replica's executables).
+
+    ``telemetry`` optionally binds the event channel to a specific
+    :class:`TelemetryRegistry` (the per-replica registries); unbound,
+    events go through the process-wide :func:`get_registry` at emit
+    time, so a registry constructed before ``telemetry.configure()``
+    still reports into the configured run."""
+
+    def __init__(self, telemetry=None) -> None:
+        self._telemetry = telemetry
+        self._lock = threading.Lock()
+        self._records: Dict[str, ProgramRecord] = {}
+        self._order: List[str] = []  # insertion order; newest = last
+        self._warm_scopes: Dict[str, bool] = {}
+        self._rcompiles = 0
+        self._unattributed_invocations = 0
+
+    # -- event channel ---------------------------------------------------------
+
+    def _tel(self, override=None):
+        if override is not None:
+            return override
+        if self._telemetry is not None:
+            return self._telemetry
+        return get_registry()
+
+    # -- the chokepoint --------------------------------------------------------
+
+    def compile_and_register(
+        self,
+        key: str,
+        lowered,
+        *,
+        scope: str = "default",
+        telemetry=None,
+    ):
+        """Compile ``lowered`` (a ``jit(...).lower(...)`` result),
+        record the executable's analyzed costs under ``key``, and
+        return the compiled object.  Compile failures propagate
+        unrecorded — callers' retry/degradation paths (the Mosaic
+        fallback in predict_memory) keep their exact semantics.
+
+        Re-registering an existing key (a score-program rebuild, a
+        second predictor warming the same shared program) updates the
+        record in place and bumps its ``recompiles`` count; the record
+        moves to the head of the newest-compile-first ordering."""
+        t0 = time.perf_counter()
+        executable = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        cost = _cost_analysis(executable)
+        mem = _memory_analysis(executable)
+        platform, kind = device_info()
+        interpret_only = peak_spec(kind) is None
+        now_wall, now_mono = time.time(), time.monotonic()
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                rec = ProgramRecord(
+                    key=key,
+                    scope=scope,
+                    compile_s=compile_s,
+                    compiled_wall=now_wall,
+                    compiled_monotonic=now_mono,
+                    platform=platform,
+                    device_kind=kind,
+                    interpret_only=interpret_only,
+                )
+                self._records[key] = rec
+            else:
+                rec.recompiles += 1
+                rec.compile_s = compile_s
+                rec.compiled_wall = now_wall
+                rec.compiled_monotonic = now_mono
+                self._order.remove(key)
+            rec.compile_times.append(compile_s)
+            rec.flops = cost.get("flops", rec.flops)
+            rec.bytes_accessed = cost.get("bytes accessed", rec.bytes_accessed)
+            rec.argument_bytes = mem.get("argument_bytes", rec.argument_bytes)
+            rec.output_bytes = mem.get("output_bytes", rec.output_bytes)
+            rec.temp_bytes = mem.get("temp_bytes", rec.temp_bytes)
+            self._order.append(key)
+        self._tel(telemetry).event(
+            "program",
+            key=key,
+            scope=scope,
+            compile_s=round(compile_s, 6),
+            flops=rec.flops,
+            bytes_accessed=rec.bytes_accessed,
+            hbm_bytes=rec.hbm_bytes,
+            device_kind=kind,
+        )
+        return executable
+
+    # -- runtime accounting ----------------------------------------------------
+
+    def record_invocation(self, key: str, seconds: Optional[float] = None) -> None:
+        """One dispatch of a registered program; ``seconds`` is the
+        host-observed device time of the launch when the call site has
+        it (the serving chunk scorer, the trainer step timer) — the
+        async streaming paths count invocations only rather than
+        reintroduce a per-batch host sync."""
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                self._unattributed_invocations += 1
+                return
+            rec.invocations += 1
+            if seconds is not None and seconds > 0:
+                rec.device_time_s += float(seconds)
+
+    def mark_warm(self, scope: str, warm: bool = True) -> None:
+        """Warmup-state edge for a compile scope: traces in a warm
+        scope escalate to ``rcompile`` events.  AOT warmups and the
+        trainers' first epoch call ``mark_warm(scope, False)`` on
+        entry (a rebuild/re-warm is intentional recompilation) and
+        ``mark_warm(scope)`` when every expected shape is compiled."""
+        with self._lock:
+            self._warm_scopes[scope] = bool(warm)
+
+    def is_warm(self, scope: str) -> bool:
+        with self._lock:
+            return self._warm_scopes.get(scope, False)
+
+    def note_trace(self, scope: str, key: str, telemetry=None) -> None:
+        """Called at TRACE time from the jit probe wrappers (the
+        ``score_trace_count`` / ``train_trace_count`` bodies): a trace
+        is a jit cache miss, i.e. a compile is about to happen.  In a
+        warm scope that is the diagnosable event this registry exists
+        for — emit ``rcompile`` with the offending shape key."""
+        with self._lock:
+            warm = self._warm_scopes.get(scope, False)
+            if warm:
+                self._rcompiles += 1
+        if warm:
+            self._tel(telemetry).event("rcompile", scope=scope, key=key)
+
+    # -- read surfaces ---------------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Per-program rows, newest compile first (the ``/programz``
+        ordering)."""
+        with self._lock:
+            records = [self._records[k] for k in reversed(self._order)]
+            return [r.as_dict(peak_spec(r.device_kind)) for r in records]
+
+    def last_compile(self) -> Optional[Dict[str, Any]]:
+        """The most recent registered compile — the bench watchdog's
+        wedged-init vs slow-first-step discriminator."""
+        with self._lock:
+            if not self._order:
+                return None
+            rec = self._records[self._order[-1]]
+            return {
+                "key": rec.key,
+                "compile_s": round(rec.compile_s, 6),
+                "age_s": time.monotonic() - rec.compiled_monotonic,
+            }
+
+    def roofline(self) -> Dict[str, Any]:
+        """Aggregate achieved-vs-peak figures over every recorded
+        program (CPU and unknown devices are interpret-only: analyzed
+        costs still report, the MFU denominators stay null)."""
+        with self._lock:
+            records = list(self._records.values())
+        platform, kind = device_info()
+        if records:
+            platform = records[-1].platform
+            kind = records[-1].device_kind
+        peak = peak_spec(kind)
+        flops_total = sum(r.flops * r.invocations for r in records)
+        bytes_total = sum(r.bytes_accessed * r.invocations for r in records)
+        device_time = sum(r.device_time_s for r in records)
+        achieved_flops = flops_total / device_time if device_time > 0 else None
+        achieved_bytes = bytes_total / device_time if device_time > 0 else None
+        mfu = None
+        membw_util = None
+        if peak is not None and achieved_flops is not None:
+            mfu = achieved_flops / peak["flops_per_s"]
+        if peak is not None and achieved_bytes is not None:
+            membw_util = achieved_bytes / peak["hbm_bytes_per_s"]
+        return {
+            "platform": platform,
+            "device_kind": kind,
+            "interpret_only": peak is None,
+            "peak_flops_per_s": peak["flops_per_s"] if peak else None,
+            "peak_bytes_per_s": peak["hbm_bytes_per_s"] if peak else None,
+            "programs": len(records),
+            "flops_total": flops_total,
+            "bytes_total": bytes_total,
+            "device_time_s": round(device_time, 6),
+            "achieved_flops_per_s": achieved_flops,
+            "achieved_bytes_per_s": achieved_bytes,
+            "mfu": mfu,
+            "membw_util": membw_util,
+        }
+
+    def metrics_part(self) -> Dict[str, Any]:
+        """The ``xla.*`` rows as one snapshot-shaped dict, for merging
+        as an extra part into the Prometheus exposition.  Empty when
+        nothing is registered, so a process that never compiles scrapes
+        exactly its pre-registry metric set."""
+        with self._lock:
+            records = list(self._records.values())
+            rcompiles = self._rcompiles
+            unattributed = self._unattributed_invocations
+        if not records:
+            return {}
+        roof = self.roofline()
+        compile_times = sorted(
+            t for r in records for t in r.compile_times
+        )
+        total_compiles = len(compile_times)
+        hist = {
+            "count": float(total_compiles),
+            "total": sum(compile_times),
+            "mean": sum(compile_times) / total_compiles,
+            "min": compile_times[0],
+            "max": compile_times[-1],
+            "p50": compile_times[(total_compiles - 1) // 2],
+            "p95": compile_times[
+                min(total_compiles - 1, int(round((total_compiles - 1) * 0.95)))
+            ],
+        }
+        counters = {
+            "xla.programs": len(records),
+            "xla.compiles": total_compiles,
+            "xla.recompiles": rcompiles,
+            "xla.invocations": (
+                sum(r.invocations for r in records) + unattributed
+            ),
+            "xla.flops_total": int(roof["flops_total"]),
+            "xla.bytes_total": int(roof["bytes_total"]),
+        }
+        gauges: Dict[str, float] = {
+            "xla.device_time_s": roof["device_time_s"],
+            "xla.interpret_only": 1.0 if roof["interpret_only"] else 0.0,
+            "xla.hbm_bytes": float(max(r.hbm_bytes for r in records)),
+        }
+        for gauge_name, value in (
+            ("xla.mfu", roof["mfu"]),
+            ("xla.achieved_flops_per_s", roof["achieved_flops_per_s"]),
+            ("xla.achieved_bytes_per_s", roof["achieved_bytes_per_s"]),
+        ):
+            if value is not None:
+                gauges[gauge_name] = float(value)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {"xla.compile_s": hist},
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._order.clear()
+            self._warm_scopes.clear()
+            self._rcompiles = 0
+            self._unattributed_invocations = 0
+
+
+# -- process-wide instance -----------------------------------------------------
+
+_programs = ProgramRegistry()
+
+
+def get_program_registry() -> ProgramRegistry:
+    """The process-wide program registry (trainers, the offline
+    predictors, and single-service serving all record here; replica
+    factories construct their own per-replica instances)."""
+    return _programs
+
+
+def write_programs(run_dir) -> None:
+    """Persist the process registry's programs + roofline beside the
+    telemetry sinks (``<run_dir>/programs.json``) so telemetry-report
+    renders the PROGRAMS table post-hoc.  No-op when nothing was
+    registered — pre-registry run dirs and program-free runs stay
+    byte-identical."""
+    import json
+    from pathlib import Path
+
+    # lazy, mirroring sinks.py: telemetry never imports resilience at
+    # module load, only the atomic-commit helper at write time
+    from ..resilience.io import atomic_write_text
+
+    snapshot = _programs.snapshot()
+    if not snapshot:
+        return
+    payload = {
+        "schema": 1,
+        "written_wall": time.time(),
+        "programs": snapshot,
+        "roofline": _programs.roofline(),
+    }
+    atomic_write_text(
+        Path(run_dir) / "programs.json",
+        json.dumps(payload, indent=2, default=float),
+    )

@@ -412,8 +412,13 @@ def configure(
 
 def reset() -> None:
     """Close any configured registry and restore the disabled default
-    (tests)."""
+    (tests).  Also clears the process-wide program registry — the two
+    describe one run, so tests that reset telemetry state get a clean
+    compiled-program slate too."""
     global _current
     if _current is not _default:
         _current.close()
     _current = _default
+    from .programs import get_program_registry  # local: import cycle
+
+    get_program_registry().reset()

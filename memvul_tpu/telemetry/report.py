@@ -250,6 +250,106 @@ def _as_num(v: Any) -> float:
         return 0.0
 
 
+def _load_programs(
+    run_dir: Path, events: List[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """The compiled-program table for the PROGRAMS/ROOFLINE sections.
+
+    Preferred source: ``programs.json`` (telemetry/programs.py
+    ``write_programs`` — full records incl. invocation counts and the
+    roofline aggregate).  A run killed before that file landed still
+    has its per-compile ``program`` events in the stream, so those
+    reconstruct a partial table (no invocation/roofline data).  A
+    pre-registry run dir has neither and renders "(no programs
+    recorded)"."""
+    import json
+
+    path = run_dir / "programs.json"
+    if path.exists():
+        try:
+            payload = json.loads(path.read_text())
+            programs = payload.get("programs") or []
+            if isinstance(programs, list):
+                return {
+                    "source": "programs.json",
+                    "programs": programs,
+                    "roofline": payload.get("roofline"),
+                }
+        except (OSError, ValueError):  # torn write → fall back to events
+            pass
+    rows: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.get("kind") != "program":
+            continue
+        rows.append({
+            "key": ev.get("key"),
+            "scope": ev.get("scope"),
+            "compile_s": ev.get("compile_s"),
+            "flops": ev.get("flops"),
+            "bytes_accessed": ev.get("bytes_accessed"),
+            "hbm_bytes": ev.get("hbm_bytes"),
+            "device_kind": ev.get("device_kind"),
+        })
+    return {
+        "source": "events" if rows else None,
+        "programs": rows,
+        "roofline": None,
+    }
+
+
+def _programs_section(programs: Dict[str, Any]) -> List[str]:
+    """PROGRAMS + ROOFLINE text rendering; always emits the PROGRAMS
+    header so an operator sees explicitly when a run predates the
+    program registry."""
+    lines = ["PROGRAMS (compiled XLA executables)"]
+    rows = programs["programs"]
+    if not rows:
+        lines.append("  (no programs recorded)")
+        return lines
+    if programs["source"] == "events":
+        lines.append(
+            "  (reconstructed from program events — programs.json "
+            "missing; invocation counts unavailable)"
+        )
+    lines.append(
+        f"  {'key':<40} {'scope':<7} {'compile':>9} {'flops':>12}"
+        f" {'hbm_bytes':>12} {'calls':>7}"
+    )
+    for row in rows[:20]:
+        lines.append(
+            f"  {str(row.get('key'))[:40]:<40}"
+            f" {str(row.get('scope', '-')):<7}"
+            f" {_fmt_s(row.get('compile_s')):>9}"
+            f" {_fmt_num(row.get('flops', '-')):>12}"
+            f" {_fmt_num(row.get('hbm_bytes', '-')):>12}"
+            f" {_fmt_num(row.get('invocations', '-')):>7}"
+        )
+    if len(rows) > 20:
+        lines.append(f"  (+{len(rows) - 20} more programs)")
+    roof = programs.get("roofline")
+    if roof:
+        lines.append("")
+        lines.append("ROOFLINE")
+        lines.append(
+            f"  device: {roof.get('device_kind', '?')}"
+            + ("  (interpret-only — no peak spec, MFU unavailable)"
+               if roof.get("interpret_only") else "")
+        )
+        lines.append(
+            f"  programs: {_fmt_num(roof.get('programs', 0))}"
+            f"  flops_total: {_fmt_num(roof.get('flops_total', 0))}"
+            f"  device_time: {_fmt_s(roof.get('device_time_s'))}"
+        )
+        if roof.get("mfu") is not None:
+            lines.append(
+                f"  mfu: {_as_num(roof.get('mfu')):.4f}"
+                f"  membw_util: {_fmt_num(roof.get('membw_util', '-'))}"
+                f"  achieved_flops_per_s:"
+                f" {_fmt_num(roof.get('achieved_flops_per_s', '-'))}"
+            )
+    return lines
+
+
 # the per-request journey stages (serving/service.py tracing): together
 # they partition enqueued→resolved, so their totals decompose serve
 # latency into WHERE a request spent its time
@@ -334,7 +434,7 @@ def report_json(
     keys are pinned by tests (the ``lint --json`` pattern): ``schema``,
     ``run_dir``, ``events``, ``heartbeat``, ``spans``, ``counters``,
     ``gauges``, ``histograms``, ``derived``, ``latency_decomposition``,
-    ``replicas``."""
+    ``replicas``, ``programs``, ``roofline``."""
     data = load_run(run_dir)
     now = time.time() if now is None else now
     summary = data["summary"]
@@ -349,6 +449,7 @@ def report_json(
         )
     except (TypeError, ValueError):
         heartbeat_age = None
+    programs = _load_programs(data["run_dir"], data["events"])
     return {
         "schema": 1,
         "run_dir": str(data["run_dir"]),
@@ -367,6 +468,8 @@ def report_json(
         "derived": _derived_metrics(counters),
         "latency_decomposition": _latency_decomposition(histograms),
         "replicas": _replica_rows(data["run_dir"], data["events"], now),
+        "programs": programs["programs"],
+        "roofline": programs["roofline"],
     }
 
 
@@ -527,6 +630,12 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
     if anchor_lines:
         lines.append("")
         lines.extend(anchor_lines)
+
+    # -- compiled programs / roofline (telemetry/programs.py) ------------------
+    lines.append("")
+    lines.extend(
+        _programs_section(_load_programs(data["run_dir"], events))
+    )
 
     # -- replicas (scale-out serving runs) ------------------------------------
     replica_lines = _replica_section(data["run_dir"], events, now)

@@ -34,6 +34,7 @@ from ..data.readers import DatasetReader
 from ..models.losses import masked_cross_entropy
 from ..parallel.mesh import replicate, shard_batch
 from ..telemetry import get_registry
+from ..telemetry.programs import get_program_registry, shape_key
 from .checkpoint import MetricTracker, TrainCheckpointer
 from .metrics import RunningClassification, device_confusion, drain_pending
 from .optim import make_optimizer
@@ -191,11 +192,16 @@ class ClassifierTrainer:
         # recompile probe (same contract as MemoryTrainer): the wrapper
         # body runs only when jit traces
         self.train_trace_count = 0
+        # program-registry adoption, same contract as MemoryTrainer
+        self._programs = get_program_registry()
+        self._step_shapes: set = set()
+        self._programs.mark_warm("train", warm=False)
         raw_step = make_classifier_step(self.model, self.tx)
 
         def traced_step(*args):
             self.train_trace_count += 1
             get_registry().counter("train.recompiles").inc()
+            self._programs.note_trace("train", shape_key("train_step", args[-1]))
             return raw_step(*args)
 
         self._step_fn = jit_step(
@@ -203,6 +209,21 @@ class ClassifierTrainer:
             donate=(0, 1, 2),
             debug_checks=c.debug_checks,
         )
+
+    def _register_step_program(self, *args) -> str:
+        """First occurrence of a batch shape routes through the program
+        registry's ``lower().compile()`` chokepoint (see
+        ``MemoryTrainer._register_step_program``)."""
+        key = shape_key("train_step", args[-1])
+        if key in self._step_shapes:
+            return key
+        self._step_shapes.add(key)
+        lower = getattr(self._step_fn, "lower", None)
+        if lower is not None:
+            self._programs.compile_and_register(
+                key, lower(*args), scope="train"
+            )
+        return key
 
     # -- data ----------------------------------------------------------------
 
@@ -297,18 +318,25 @@ class ClassifierTrainer:
                     break
                 padded_tokens += info["padded_tokens"]
                 real_tokens += info["real_tokens"]
+                program_key = self._register_step_program(
+                    self.params, self.opt_state, self.rng, batch
+                )
                 with timer.step():
                     self.params, self.opt_state, self.rng, stats = self._step_fn(
                         self.params, self.opt_state, self.rng, batch
                     )
                     pending.append(stats)
                     self.step += 1
+                self._programs.record_invocation(
+                    program_key, timer.durations[-1]
+                )
                 if len(pending) >= max(1, c.sync_every):
                     with timer.distribute_over_last(len(pending)):
                         drain()
             if pending:
                 with timer.distribute_over_last(len(pending)):
                     drain()
+        self._programs.mark_warm("train")
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started

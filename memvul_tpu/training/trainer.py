@@ -53,6 +53,7 @@ from ..parallel.mesh import DATA_AXIS, replicate, shard_batch
 from ..resilience import faults
 from ..resilience.io import atomic_write_text
 from ..telemetry import get_registry
+from ..telemetry.programs import get_program_registry, shape_key
 from .checkpoint import MetricTracker, TrainCheckpointer
 from .metrics import RunningClassification, device_confusion, drain_pending
 from .optim import linear_with_warmup, make_optimizer, make_schedule
@@ -360,11 +361,18 @@ class MemoryTrainer:
         # the counter ticks exactly when jit misses its cache (a new
         # stack shape mid-run = a silent multi-second stall on TPU)
         self.train_trace_count = 0
+        # compiled-program registry (telemetry/programs.py): the step
+        # program registers lazily per stack shape; a fresh trainer has
+        # warmed nothing, so its first-epoch traces are not recompiles
+        self._programs = get_program_registry()
+        self._step_shapes: set = set()
+        self._programs.mark_warm("train", warm=False)
         raw_step = make_train_step(self.model, self.tx, ema_decay=c.ema_decay)
 
         def traced_step(*args):
             self.train_trace_count += 1
             get_registry().counter("train.recompiles").inc()
+            self._programs.note_trace("train", shape_key("train_step", args[-1]))
             return raw_step(*args)
 
         # EMA rides inside the one jitted step (no second dispatch); input
@@ -375,6 +383,25 @@ class MemoryTrainer:
             donate=(0, 1, 2, 3) if c.ema_decay is not None else (0, 1, 2),
             debug_checks=c.debug_checks,
         )
+
+    def _register_step_program(self, *args) -> str:
+        """Route the first occurrence of a stack shape through the
+        program-registry chokepoint (``lower().compile()`` populates the
+        same executable cache the jit call hits, so the step right after
+        pays no second compile) and return the shape's registry key.
+        Already-seen shapes return their key without touching jit.  The
+        checkify debug wrapper exposes no ``.lower`` — those runs skip
+        registration and compile lazily, as before."""
+        key = shape_key("train_step", args[-1])
+        if key in self._step_shapes:
+            return key
+        self._step_shapes.add(key)
+        lower = getattr(self._train_step, "lower", None)
+        if lower is not None:
+            self._programs.compile_and_register(
+                key, lower(*args), scope="train"
+            )
+        return key
 
     # -- data ----------------------------------------------------------------
 
@@ -575,23 +602,28 @@ class MemoryTrainer:
                 # chaos hook: "step.<global step index>" fires at the
                 # start of the step (docs/fault_tolerance.md)
                 faults.fault_point(f"step.{self.step}")
+                step_args = (
+                    (self.params, self.opt_state, self.rng, self.ema_params,
+                     stack)
+                    if self.ema_params is not None
+                    else (self.params, self.opt_state, self.rng, stack)
+                )
+                program_key = self._register_step_program(*step_args)
                 with timer.step():
                     if self.ema_params is not None:
                         (
                             self.params, self.opt_state, self.rng,
                             self.ema_params, stats,
-                        ) = self._train_step(
-                            self.params, self.opt_state, self.rng,
-                            self.ema_params, stack,
-                        )
+                        ) = self._train_step(*step_args)
                     else:
                         self.params, self.opt_state, self.rng, stats = (
-                            self._train_step(
-                                self.params, self.opt_state, self.rng, stack
-                            )
+                            self._train_step(*step_args)
                         )
                     pending.append(stats)
                     self.step += 1
+                self._programs.record_invocation(
+                    program_key, timer.durations[-1]
+                )
                 self._epoch_stacks_done = i + 1
                 if len(pending) >= max(1, c.sync_every):
                     with timer.distribute_over_last(len(pending)):
@@ -618,6 +650,9 @@ class MemoryTrainer:
             if pending:
                 with timer.distribute_over_last(len(pending)):
                     self._drain_stats(pending, running, losses, grad_norms)
+        # the epoch's shape set is the warm set: any step-program trace
+        # from here on is a recompile regression (rcompile attribution)
+        self._programs.mark_warm("train")
         metrics = running.compute()
         metrics["loss"] = float(np.mean(losses)) if losses else 0.0
         metrics["epoch_seconds"] = time.perf_counter() - started

@@ -1,0 +1,550 @@
+"""Compiled-program observability plane (docs/observability.md,
+"XLA program registry").
+
+Acceptance contracts proven here:
+
+* every ``compile_and_register`` call records compile time + analyzed
+  costs, re-registration bumps ``recompiles`` and moves the record to
+  the head of the newest-compile-first ordering, and the ``xla.*``
+  metrics part / roofline aggregate derive from exactly that state
+  (CPU hosts are interpret-only: costs report, MFU stays null);
+* a trace in a warm scope emits an ``rcompile`` event naming the
+  offending shape key; cold scopes stay quiet (warmup compiles are not
+  alarms);
+* ``GET /programz`` serves the registry rows on both the live
+  (train/score) exposition server and the serving front end, and the
+  router merge stamps rows with their replica name;
+* a tiny train run with the live exposition server up is scrapeable
+  mid-run from a client thread, the scrape agrees with the registry
+  snapshot, and the port closes cleanly at exit — including the
+  SIGTERM-preemption path;
+* ``telemetry-report`` renders PROGRAMS + ROOFLINE from
+  ``programs.json`` (events-reconstruction fallback for torn runs) and
+  degrades to "(no programs recorded)" on pre-registry run dirs;
+* the bench watchdog failure record names the last registered compile
+  (wedged ``kernel.lower`` vs slow first step), and bench records
+  carry per-program blocks.
+
+Everything is CPU + tiny geometry.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu import telemetry
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.resilience import faults
+from memvul_tpu.telemetry.exposition import (
+    parse_exposition,
+    sanitize_metric_name,
+)
+from memvul_tpu.telemetry.programs import (
+    ProgramRegistry,
+    get_program_registry,
+    peak_spec,
+    shape_key,
+    write_programs,
+)
+from memvul_tpu.telemetry.report import report_json
+from memvul_tpu.training.trainer import MemoryTrainer, TrainerConfig
+
+WS_SEED = 13
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    faults.reset()
+    yield
+    telemetry.reset()
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("programs"), seed=WS_SEED)
+
+
+def make_trainer(ws, out_dir=None, **cfg_kw):
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"],
+        anchor_path=ws["paths"]["anchors"],
+        same_diff_ratio={"same": 2, "diff": 2},
+        sample_neg=0.5,
+        seed=2021,
+    )
+    defaults = dict(
+        num_epochs=1, patience=None, batch_size=4, grad_accum=2,
+        max_length=32, warmup_steps=2, base_lr=1e-3, steps_per_epoch=2,
+        sync_every=1, serialization_dir=str(out_dir) if out_dir else None,
+    )
+    defaults.update(cfg_kw)
+    return MemoryTrainer(
+        model, params, ws["tokenizer"], reader,
+        train_path=ws["paths"]["train"], config=TrainerConfig(**defaults),
+    )
+
+
+def register_tiny(registry, key, scope="unit"):
+    """One real (tiny) XLA executable through the chokepoint."""
+    fn = jax.jit(lambda x: x * 2.0)
+    lowered = fn.lower(np.ones((2, 2), np.float32))
+    return registry.compile_and_register(key, lowered, scope=scope)
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
+# -- registry unit contracts ---------------------------------------------------
+
+
+def test_shape_key_is_sorted_and_deduped():
+    tree = {
+        "a": np.zeros((2, 8)), "b": np.zeros((4, 8)), "c": np.zeros((2, 8)),
+    }
+    assert shape_key("train_step", tree) == "train_step:2x8,4x8"
+    assert shape_key("empty", {}) == "empty"
+
+
+def test_peak_spec_matches_substring_and_cpu_is_interpret_only():
+    assert peak_spec("TPU v5 lite")["flops_per_s"] == 197e12
+    assert peak_spec("TPU v5p chip") is not None
+    assert peak_spec("cpu") is None
+    assert peak_spec("TPU v99") is None
+
+
+def test_compile_and_register_records_costs_and_emits_program_event(tmp_path):
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    registry = ProgramRegistry()
+    executable = register_tiny(registry, "unit:2x2")
+    assert executable is not None  # the compiled object is handed back
+    (row,) = registry.snapshot()
+    assert row["key"] == "unit:2x2" and row["scope"] == "unit"
+    assert row["compile_s"] > 0.0
+    assert row["invocations"] == 0 and row["recompiles"] == 0
+    # CPU: interpret-only, never a made-up MFU
+    assert row["interpret_only"] is True and row["mfu"] is None
+    tel.close()
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    program_events = [e for e in events if e["kind"] == "program"]
+    assert [e["key"] for e in program_events] == ["unit:2x2"]
+    assert program_events[0]["scope"] == "unit"
+
+
+def test_reregister_bumps_recompiles_and_reorders_newest_first():
+    registry = ProgramRegistry()
+    register_tiny(registry, "a")
+    register_tiny(registry, "b")
+    register_tiny(registry, "a")  # rebuild of "a": newest again
+    rows = registry.snapshot()
+    assert [r["key"] for r in rows] == ["a", "b"]
+    assert rows[0]["recompiles"] == 1 and rows[1]["recompiles"] == 0
+    part = registry.metrics_part()
+    assert part["counters"]["xla.programs"] == 2
+    assert part["counters"]["xla.compiles"] == 3
+    assert part["histograms"]["xla.compile_s"]["count"] == 3.0
+
+
+def test_invocations_device_time_and_cpu_roofline():
+    registry = ProgramRegistry()
+    register_tiny(registry, "k")
+    registry.record_invocation("k", 0.5)
+    registry.record_invocation("k")          # count-only (async path)
+    registry.record_invocation("unknown")    # unattributed, never lost
+    part = registry.metrics_part()
+    assert part["counters"]["xla.invocations"] == 3
+    assert part["gauges"]["xla.device_time_s"] == 0.5
+    assert part["gauges"]["xla.interpret_only"] == 1.0
+    assert "xla.mfu" not in part["gauges"]  # no peak spec on CPU
+    roof = registry.roofline()
+    assert roof["interpret_only"] is True
+    assert roof["mfu"] is None and roof["membw_util"] is None
+    assert roof["programs"] == 1
+    (row,) = registry.snapshot()
+    assert row["invocations"] == 2 and row["device_time_s"] == 0.5
+
+
+def test_warm_scope_trace_emits_rcompile_event(tmp_path):
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    registry = ProgramRegistry()
+    assert registry.is_warm("score") is False
+    registry.note_trace("score", "score:2x8")   # cold: warmup compile
+    registry.mark_warm("score")
+    registry.note_trace("score", "score:4x8")   # warm: the alarm
+    registry.mark_warm("score", warm=False)     # re-warm window opens
+    registry.note_trace("score", "score:8x8")   # intentional: quiet
+    register_tiny(registry, "score:4x8", scope="score")
+    assert registry.metrics_part()["counters"]["xla.recompiles"] == 1
+    tel.close()
+    events = [
+        json.loads(line)
+        for line in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    rcompiles = [e for e in events if e["kind"] == "rcompile"]
+    assert [(e["scope"], e["key"]) for e in rcompiles] == [
+        ("score", "score:4x8")
+    ]
+
+
+def test_last_compile_names_newest_key_with_age():
+    registry = ProgramRegistry()
+    assert registry.last_compile() is None
+    register_tiny(registry, "k1")
+    register_tiny(registry, "k2")
+    last = registry.last_compile()
+    assert last["key"] == "k2"
+    assert last["age_s"] >= 0.0 and last["compile_s"] > 0.0
+
+
+def test_empty_registry_contributes_nothing(tmp_path):
+    registry = ProgramRegistry()
+    assert registry.metrics_part() == {}
+    write_programs(tmp_path)  # process registry is empty after reset
+    assert not (tmp_path / "programs.json").exists()
+
+
+# -- persistence + telemetry-report --------------------------------------------
+
+
+def test_write_programs_and_report_sections(tmp_path, capsys):
+    from memvul_tpu.__main__ import main
+
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    registry = get_program_registry()
+    register_tiny(registry, "train_step:2x8,4x8", scope="train")
+    registry.record_invocation("train_step:2x8,4x8", 0.01)
+    write_programs(tmp_path)
+    tel.close()
+    payload = json.loads((tmp_path / "programs.json").read_text())
+    assert payload["schema"] == 1
+    assert payload["programs"][0]["key"] == "train_step:2x8,4x8"
+    assert payload["roofline"]["programs"] == 1
+    report = report_json(tmp_path)
+    assert report["programs"][0]["key"] == "train_step:2x8,4x8"
+    assert report["programs"][0]["invocations"] == 1
+    assert report["roofline"]["interpret_only"] is True
+    assert main(["telemetry-report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "PROGRAMS (compiled XLA executables)" in out
+    assert "train_step:2x8,4x8" in out
+    assert "ROOFLINE" in out and "interpret-only" in out
+
+
+def test_report_degrades_gracefully_on_pre_registry_run_dir(tmp_path, capsys):
+    """A run dir written before the registry existed — sinks but no
+    programs.json, no program events — says so instead of crashing."""
+    from memvul_tpu.__main__ import main
+
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    tel.counter("train.steps").inc(1)
+    tel.event("phase", phase="train")
+    tel.close()
+    assert not (tmp_path / "programs.json").exists()
+    report = report_json(tmp_path)
+    assert report["programs"] == [] and report["roofline"] is None
+    assert main(["telemetry-report", str(tmp_path)]) == 0
+    assert "(no programs recorded)" in capsys.readouterr().out
+
+
+def test_report_reconstructs_programs_from_events(tmp_path, capsys):
+    """A run killed before write_programs still reports its compiles —
+    the ``program`` events are the fallback source."""
+    from memvul_tpu.__main__ import main
+
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    tel.event(
+        "program", key="score:2x8", scope="score", compile_s=0.25,
+        flops=100.0, bytes_accessed=10.0, hbm_bytes=5, device_kind="cpu",
+    )
+    tel.close()
+    assert not (tmp_path / "programs.json").exists()
+    report = report_json(tmp_path)
+    assert [r["key"] for r in report["programs"]] == ["score:2x8"]
+    assert main(["telemetry-report", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "score:2x8" in out
+    assert "reconstructed from program events" in out
+
+
+# -- live exposition server ----------------------------------------------------
+
+
+def test_live_server_metrics_programz_healthz_and_close(tmp_path):
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    tel.counter("score.rows").inc(5)
+    register_tiny(get_program_registry(), "probs:2x8", scope="probs")
+    server = telemetry.start_metrics_server(0)
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    metrics = parse_exposition(_get(base + "/metrics"))
+    assert metrics["score_rows"][""] == 5.0
+    assert metrics["xla_programs"][""] == 1.0
+    assert metrics["xla_interpret_only"][""] == 1.0
+    programz = json.loads(_get(base + "/programz"))
+    assert programz["count"] == 1
+    assert programz["programs"][0]["key"] == "probs:2x8"
+    assert programz["roofline"]["interpret_only"] is True
+    healthz = json.loads(_get(base + "/healthz"))
+    assert healthz["enabled"] is True and "heartbeat_age_s" in healthz
+    with pytest.raises(urllib.error.HTTPError):
+        _get(base + "/nope")
+    server.close()
+    server.close()  # idempotent
+    with pytest.raises(OSError):
+        _get(base + "/metrics", timeout=1)
+
+
+# -- exposition under training (the integration contract) ----------------------
+
+
+def test_live_exposition_under_training(ws, tmp_path):
+    """A tiny train run with the metrics server up: a client thread
+    scrapes ``/metrics`` mid-run, every mid-run value is bounded by the
+    final registry state, the final scrape agrees with the registry
+    snapshot exactly, and the port closes cleanly at exit."""
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    server = telemetry.start_metrics_server(0)
+    port = server.server_address[1]
+    url = f"http://127.0.0.1:{port}/metrics"
+    scrapes = []
+    stop = threading.Event()
+
+    def scrape_loop():
+        while not stop.is_set():
+            try:
+                scrapes.append(parse_exposition(_get(url)))
+            except Exception:
+                pass  # server races the run's teardown; fine mid-run
+            time.sleep(0.02)
+
+    client = threading.Thread(target=scrape_loop, daemon=True)
+    client.start()
+    try:
+        make_trainer(ws).train()
+    finally:
+        stop.set()
+        client.join(timeout=10)
+    final = parse_exposition(_get(url))
+    # the scrape agrees exactly with the registry snapshots it renders
+    counters = telemetry.get_registry().snapshot()["counters"]
+    assert counters["train.steps"] == 2
+    assert final["train_steps"][""] == float(counters["train.steps"])
+    part = get_program_registry().metrics_part()
+    assert part, "the train run registered no programs"
+    for name, value in part["counters"].items():
+        assert final[sanitize_metric_name(name)][""] == float(value), name
+    assert final["xla_programs"][""] >= 1.0
+    # mid-run scrapes: monotone, never ahead of the final state
+    assert scrapes, "the client thread never completed a scrape mid-run"
+    for doc in scrapes:
+        if "train_steps" in doc:
+            assert doc["train_steps"][""] <= float(counters["train.steps"])
+        if "xla_compiles" in doc:
+            assert doc["xla_compiles"][""] <= final["xla_compiles"][""]
+    # the run entry point's finally: programs.json + clean port release
+    telemetry.write_programs(tmp_path)
+    tel.close()
+    server.close()
+    saved = json.loads((tmp_path / "programs.json").read_text())
+    assert any(
+        row["key"].startswith("train_step:") for row in saved["programs"]
+    )
+    with pytest.raises(OSError):
+        _get(url, timeout=1)
+
+
+def test_sigterm_preempted_run_releases_port_and_programs(ws, tmp_path):
+    """The preemption path unwinds through the same finally as a clean
+    exit: SIGTERM mid-train (the production handler, delivered via the
+    fault harness) still lands programs.json and frees the port."""
+    faults.configure("step.0=sigterm")
+    tel = telemetry.configure(run_dir=tmp_path, heartbeat_every_s=0.0)
+    server = telemetry.start_metrics_server(0)
+    port = server.server_address[1]
+    trainer = make_trainer(ws, out_dir=tmp_path / "out")
+    try:
+        result = trainer.train()
+    finally:
+        faults.reset()
+        # mirror build.train_from_config's finally exactly
+        telemetry.write_programs(tmp_path)
+        tel.close()
+        server.close()
+    assert result["preempted"] is True
+    assert (tmp_path / "programs.json").exists()
+    with pytest.raises(OSError):
+        _get(f"http://127.0.0.1:{port}/metrics", timeout=1)
+
+
+# -- serving surfaces ----------------------------------------------------------
+
+
+class _FakeEncoder:
+    pad_id = 0
+
+    def __init__(self, max_length=8):
+        self.max_length = max_length
+
+    def encode_many(self, texts):
+        return [[1] * min(len(t), self.max_length) for t in texts]
+
+
+class _FakePredictor:
+    """Minimal predictor surface (test_serving.py's shape) plus a real
+    program registry — what /programz reads."""
+
+    def __init__(self, n_anchors=3, rows=4, length=8):
+        self.encoder = _FakeEncoder(length)
+        self.mesh = None
+        self.params = None
+        self.n_anchors = n_anchors
+        self.anchor_labels = [f"A{i}" for i in range(n_anchors)]
+        self.anchor_bank = np.zeros((n_anchors, 2), np.float32)
+        self.score_trace_count = 0
+        self._shapes = [(rows, length)]
+        self.programs = ProgramRegistry()
+
+    def stream_shapes(self):
+        return list(self._shapes)
+
+    def _score_fn(self, params, sample, bank):
+        rows = sample["input_ids"].shape[0]
+        return np.tile(
+            np.linspace(0.1, 0.9, self.n_anchors, dtype=np.float32), (rows, 1)
+        )
+
+
+def test_service_programz_endpoint_and_xla_scrape_rows():
+    from memvul_tpu.serving.frontend import run_http_server
+    from memvul_tpu.serving.service import ScoringService, ServiceConfig
+
+    fake = _FakePredictor()
+    register_tiny(fake.programs, "score:4x8", scope="score")
+    register_tiny(fake.programs, "score:2x8", scope="score")
+    service = ScoringService(fake, config=ServiceConfig(max_wait_ms=1.0))
+    server = run_http_server(service, port=0)
+    try:
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        payload = json.loads(_get(base + "/programz"))
+        assert payload["count"] == 2
+        # newest compile first
+        assert [p["key"] for p in payload["programs"]] == [
+            "score:2x8", "score:4x8",
+        ]
+        assert payload["roofline"]["programs"] == 2
+        metrics = parse_exposition(_get(base + "/metrics"))
+        assert metrics["xla_programs"][""] == 2.0
+    finally:
+        server.shutdown()
+        service.drain()
+
+
+def test_service_without_program_registry_degrades():
+    """Predictors that predate the registry (and the test fakes) keep
+    every surface working: empty rows, no xla part, no roofline."""
+    from memvul_tpu.serving.service import ScoringService, ServiceConfig
+
+    fake = _FakePredictor()
+    del fake.programs
+    service = ScoringService(fake, config=ServiceConfig(max_wait_ms=1.0))
+    try:
+        assert service.programs_snapshot() == []
+        assert service.programs_roofline() is None
+        # no extra xla part: the scrape body is the pre-registry set
+        assert len(service.metrics_snapshots()) == 1
+    finally:
+        service.drain()
+
+
+def test_router_programs_snapshot_merges_and_stamps_replicas():
+    from memvul_tpu.serving.router import ReplicaRouter
+
+    class _StubService:
+        def __init__(self, rows):
+            self._rows = rows
+
+        def programs_snapshot(self):
+            return [dict(r) for r in self._rows]
+
+    class _StubReplica:
+        def __init__(self, name, service):
+            self.name = name
+            self.service = service
+
+    class _StubRouter:
+        replicas = [
+            _StubReplica("replica-0", _StubService(
+                [{"key": "score:2x8", "compiled_wall": 10.0}]
+            )),
+            _StubReplica("replica-1", _StubService(
+                [{"key": "score:4x8", "compiled_wall": 20.0}]
+            )),
+            _StubReplica("replica-2", None),  # dead replica: skipped
+        ]
+
+    rows = ReplicaRouter.programs_snapshot(_StubRouter())
+    assert [(r["key"], r["replica"]) for r in rows] == [
+        ("score:4x8", "replica-1"),   # newest compile first, fleet-wide
+        ("score:2x8", "replica-0"),
+    ]
+
+
+# -- bench integration ---------------------------------------------------------
+
+
+def test_watchdog_failure_record_names_last_compile(monkeypatch, capsys):
+    import memvul_tpu.bench as bench
+
+    monkeypatch.setattr(bench.os, "_exit", lambda code: None)
+    wd = bench._PhaseWatchdog(timeout=5.0, metric="siamese_scoring_throughput")
+    # nothing compiled yet (wedged kernel.lower signature): no fields
+    wd._expire("warmup_pass")
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "last_compile_key" not in record
+    register_tiny(get_program_registry(), "score:2x8", scope="score")
+    # a compile landed, then the phase wedged (slow-first-step signature)
+    wd._expire("warmup_pass")
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["watchdog_timeout"] is True
+    assert record["last_compile_key"] == "score:2x8"
+    assert record["last_compile_age_s"] >= 0.0
+
+
+def test_bench_program_blocks_shape():
+    from memvul_tpu.bench import _program_blocks
+
+    assert _program_blocks() == {}  # program-free: record shape untouched
+    registry = get_program_registry()
+    register_tiny(registry, "train_step:2x8", scope="train")
+    registry.record_invocation("train_step:2x8", 0.1)
+    blocks = _program_blocks()
+    (row,) = blocks["programs"]
+    assert row["key"] == "train_step:2x8" and row["invocations"] == 1
+    assert set(row) >= {
+        "compile_s", "flops", "hbm_bytes", "device_time_s", "mfu",
+    }
+    assert blocks["xla"]["interpret_only"] is True
+    assert "mfu" in blocks["xla"]  # present (null) even off-TPU

@@ -195,6 +195,20 @@ FIXTURES = {
         },
         "target": ("pkg/use.py", 4),
     },
+    "MV405": {
+        "files": {
+            "pkg/warm.py": (
+                "def warm(step_fn, sample):\n"
+                "    return step_fn.lower(sample).compile()\n"
+            ),
+            # the chokepoint itself is the one sanctioned raw-compile site
+            "pkg/telemetry/programs.py": (
+                "def compile_and_register(key, fn, sample):\n"
+                "    return fn.lower(sample).compile()\n"
+            ),
+        },
+        "target": ("pkg/warm.py", 2),
+    },
 }
 
 
@@ -631,6 +645,22 @@ def test_config_checker_resolves_get_calls(tmp_path):
     assert {(f.path, f.symbol) for f in result.active} == {
         ("pkg/use.py", "typo"), ("pkg/use2.py", "also_typo"),
     }
+
+
+def test_compile_checker_exempts_the_chokepoint(tmp_path):
+    """MV405 exempts exactly telemetry/programs.py — the chokepoint
+    itself must raw-compile, everyone else routes through it."""
+    _write_tree(tmp_path, dict(FIXTURES["MV405"]["files"]))
+    result = _analyze_fixture(tmp_path, select=["MV405"])
+    assert [(f.path, f.line) for f in result.active] == [("pkg/warm.py", 2)]
+
+
+def test_real_tree_has_no_registry_bypass_compiles(repo_result):
+    """Satellite: every compile site in the package goes through
+    ProgramRegistry.compile_and_register (MV405 clean on the real
+    tree — already implied by the clean-tree gate, pinned separately
+    so a bypass regression names the right checker)."""
+    assert [f for f in repo_result.active if f.code == "MV405"] == []
 
 
 def test_registered_fault_points_match_real_call_sites(repo_result):
