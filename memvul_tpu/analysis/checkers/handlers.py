@@ -1,7 +1,8 @@
-"""MV102 — handler threads only enqueue + wait; routers only select.
+"""MV102 — handler threads only enqueue + wait; routers only select;
+dispatcher admission paths never block.
 
 Migrated from ``tools/lint_no_blocking_in_handler.py`` (now a
-delegating shim).  Two class families, wherever they live:
+delegating shim).  Three class families, wherever they live:
 
 * classes with a base whose name ends with ``RequestHandler`` — one
   thread per connection; anything blocking serializes the whole server
@@ -9,7 +10,18 @@ delegating shim).  Two class families, wherever they live:
   micro-batcher exists to prevent (docs/serving.md);
 * classes named ``*Router`` (or deriving from one) — a routing decision
   reads queue depths and picks a replica, nothing more; heavy fleet
-  operations belong to control-plane workers.
+  operations belong to control-plane workers;
+* classes named ``*Dispatcher`` (or deriving from one;
+  serving/dispatch.py) — the batcher strategies themselves.  Their JOB
+  is to encode, pack, and score, so the serving-surface names stay
+  legal here; what the admission path must never do is stall on a
+  synchronous convenience API (``score_texts`` round-trips the device
+  per call) or a bare ``time.sleep`` (waits go through condition
+  variables and queue timeouts so drain/kill flags are noticed), and
+  ``predict*`` entry points are offline-evaluation surface, not
+  dispatch surface.  Continuous admission makes this structural: a
+  blocked admission loop re-couples queue_wait to device latency — the
+  exact coupling the dispatcher exists to remove.
 
 The forbidden-name set is the serving tier's scoring/encoding/packing
 surface plus ``sleep`` and the fleet control-plane entry points
@@ -56,6 +68,11 @@ FORBIDDEN_NAMES = {
 }
 FORBIDDEN_PREFIXES = ("predict",)
 
+# the dispatcher admission-path set is deliberately NARROW: packing,
+# collation, encoding and the jitted score fns are a dispatcher's whole
+# purpose — only the stall-shaped calls are banned (see module docstring)
+DISPATCHER_FORBIDDEN_NAMES = {"sleep", "score_texts"}
+
 
 def _base_name(base: ast.expr) -> str:
     if isinstance(base, ast.Attribute):
@@ -77,31 +94,47 @@ def _is_router_class(node: ast.ClassDef) -> bool:
     return any(_base_name(b).endswith("Router") for b in node.bases)
 
 
+def _is_dispatcher_class(node: ast.ClassDef) -> bool:
+    if node.name.endswith("Dispatcher"):
+        return True
+    return any(_base_name(b).endswith("Dispatcher") for b in node.bases)
+
+
 @register(
     CODE,
     "blocking-in-handler",
-    "blocking call in an HTTP handler or router dispatch class",
+    "blocking call in an HTTP handler, router, or dispatcher class",
 )
 def check(ctx: AnalysisContext) -> Iterator[Finding]:
     for pf in ctx.files:
         if pf.tree is None:
             continue
         for node in ast.walk(pf.tree):
-            if not (
-                isinstance(node, ast.ClassDef)
-                and (_is_handler_class(node) or _is_router_class(node))
-            ):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if _is_handler_class(node) or _is_router_class(node):
+                forbidden = FORBIDDEN_NAMES
+                contract = (
+                    "a handler may only submit() and wait on the future; "
+                    "a router may only select a replica queue"
+                )
+            elif _is_dispatcher_class(node):
+                forbidden = DISPATCHER_FORBIDDEN_NAMES
+                contract = (
+                    "a dispatcher's admission path waits on condition "
+                    "variables and queue timeouts, never sleeps or "
+                    "round-trips the device per request"
+                )
+            else:
                 continue
             for call in ast.walk(node):
                 if not isinstance(call, ast.Call):
                     continue
                 name = called_name(call)
-                if name in FORBIDDEN_NAMES or name.startswith(FORBIDDEN_PREFIXES):
+                if name in forbidden or name.startswith(FORBIDDEN_PREFIXES):
                     yield Finding(
                         CODE, pf.rel, call.lineno,
-                        f"blocking call {name}() inside {node.name} — a "
-                        "handler may only submit() and wait on the future; "
-                        "a router may only select a replica queue "
-                        "(docs/serving.md)",
+                        f"blocking call {name}() inside {node.name} — "
+                        f"{contract} (docs/serving.md)",
                         symbol=name,
                     )

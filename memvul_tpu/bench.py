@@ -765,20 +765,32 @@ def _run_serve_micro() -> None:
         }
         for i in range(n_anchors)
     ]
+    # serve dispatch A/B (docs/ragged_serving.md, docs/serving.md):
+    # BENCH_SERVE_IMPL picks the dispatch strategy — "bucketed"
+    # (default), "ragged", "continuous", or "ab", which drives ALL
+    # THREE with the identical seeded schedule so one record quantifies
+    # both the padding win (real_token_utilization, ragged vs bucketed)
+    # and the admission win (queue_wait_gain, continuous vs ragged)
+    impl_mode = os.environ.get("BENCH_SERVE_IMPL", "bucketed")
+    if impl_mode not in ("bucketed", "ragged", "continuous", "ab"):
+        raise SystemExit(
+            "BENCH_SERVE_IMPL must be bucketed|ragged|continuous|ab, "
+            f"got {impl_mode!r}"
+        )
+    # the queue_wait comparison needs the per-stage trace histograms;
+    # tracing stays off for single-leg runs so their numbers keep the
+    # zero-overhead default (override with BENCH_SERVE_TRACE_RATE)
+    trace_rate = float(
+        os.environ.get(
+            "BENCH_SERVE_TRACE_RATE", "1.0" if impl_mode == "ab" else "0.0"
+        )
+    )
     service_config = ServiceConfig(
         max_batch=max_batch, max_wait_ms=max_wait_ms,
         max_queue=max(256, 2 * n_clients * max_batch),
         default_deadline_ms=0.0,  # measure latency, don't shed it
+        trace_sample_rate=trace_rate,
     )
-    # ragged serve A/B (docs/ragged_serving.md): BENCH_SERVE_IMPL picks
-    # the dispatch path — "bucketed" (default), "ragged", or "ab", which
-    # drives BOTH paths with the identical seeded schedule so the record
-    # quantifies the padding win (real_token_utilization) directly
-    impl_mode = os.environ.get("BENCH_SERVE_IMPL", "bucketed")
-    if impl_mode not in ("bucketed", "ragged", "ab"):
-        raise SystemExit(
-            f"BENCH_SERVE_IMPL must be bucketed|ragged|ab, got {impl_mode!r}"
-        )
     token_budget = int(
         os.environ.get("BENCH_SERVE_TOKEN_BUDGET", str(4 * seq_len))
     )
@@ -786,10 +798,10 @@ def _run_serve_micro() -> None:
     def build_service(registry=None, impl: str = "bucketed") -> ScoringService:
         kwargs = (
             dict(
-                score_impl="ragged", token_budget=token_budget,
+                score_impl=impl, token_budget=token_budget,
                 max_rows_per_pack=max_batch,
             )
-            if impl == "ragged" else {}
+            if impl in ("ragged", "continuous") else {}
         )
         predictor = SiamesePredictor(
             model, params, ws["tokenizer"],
@@ -859,9 +871,20 @@ def _run_serve_micro() -> None:
                 t.join()
             elapsed = time.perf_counter() - start
         service.drain()
-        counters = registry.snapshot()["counters"]
+        snap = registry.snapshot()
+        counters = snap["counters"]
         real = int(counters.get("serve.tokens_real", 0))
         padded = int(counters.get("serve.tokens_padded", 0))
+        # admission latency (enqueued→coalesced), only populated when the
+        # per-stage trace histograms are on (trace_rate > 0 — ab mode)
+        qw = snap.get("histograms", {}).get("serve.queue_wait_s")
+        queue_wait_ms = (
+            {
+                "p50": round(qw["p50"] * 1e3, 3),
+                "p95": round(qw["p95"] * 1e3, 3),
+            }
+            if qw and qw.get("count") else None
+        )
         lat_ms = np.sort(np.asarray(latencies)) * 1e3
         pct = (
             lambda q: round(float(np.percentile(lat_ms, q)), 3)
@@ -884,13 +907,15 @@ def _run_serve_micro() -> None:
             "real_token_utilization": (
                 round(real / padded, 4) if padded else None
             ),
+            "queue_wait_ms": queue_wait_ms,
         }
 
     legs = (
-        ["bucketed", "ragged"] if impl_mode == "ab" else [impl_mode]
+        ["bucketed", "ragged", "continuous"] if impl_mode == "ab"
+        else [impl_mode]
     )
     records = [_drive_leg(impl) for impl in legs]
-    primary = records[-1]  # ragged in ab mode; the single leg otherwise
+    primary = records[-1]  # continuous in ab mode; the single leg otherwise
     record = {
         "metric": "serve_microbench",
         "value": primary["requests_per_sec"],
@@ -902,6 +927,7 @@ def _run_serve_micro() -> None:
         "real_tokens": primary["real_tokens"],
         "padded_tokens": primary["padded_tokens"],
         "real_token_utilization": primary["real_token_utilization"],
+        "queue_wait_ms": primary["queue_wait_ms"],
         "config": {
             "model": os.environ.get("BENCH_MODEL", "base"),
             "seq_len": seq_len,
@@ -923,6 +949,14 @@ def _run_serve_micro() -> None:
         if bucketed_util and ragged_util:
             record["utilization_gain"] = round(
                 ragged_util / bucketed_util, 3
+            )
+        # the continuous win: p50 admission wait vs the seal-then-admit
+        # ragged loop on the identical seeded schedule
+        ragged_qw = by_impl["ragged"]["queue_wait_ms"]
+        cont_qw = by_impl["continuous"]["queue_wait_ms"]
+        if ragged_qw and cont_qw and cont_qw["p50"]:
+            record["queue_wait_gain"] = round(
+                ragged_qw["p50"] / cont_qw["p50"], 2
             )
     print(json.dumps(record))
 

@@ -410,10 +410,10 @@ def serve_from_archive(
     # envelope (budget covers max_batch typical-length requests only if
     # configured — the default 4×max_length favors a small warm program)
     score_impl = str(serve_cfg["score_impl"])
-    if score_impl not in ("bucketed", "ragged"):
+    if score_impl not in ("bucketed", "ragged", "continuous"):
         raise ValueError(
-            f"serving.score_impl must be 'bucketed' or 'ragged', got "
-            f"{score_impl!r}"
+            f"serving.score_impl must be 'bucketed', 'ragged' or "
+            f"'continuous', got {score_impl!r}"
         )
     token_budget = serve_cfg["token_budget"]
     token_budget = None if token_budget is None else int(token_budget)

@@ -324,10 +324,13 @@ SERVING_DEFAULTS: Dict[str, Any] = {
     "max_length": 512,       # token cap (clamped to the model's positions)
     "buckets": None,         # explicit length buckets ("auto" needs a
                              # corpus and is an offline-only policy)
-    # ragged serve path (docs/ragged_serving.md): "ragged" packs each
-    # pull into fixed [1, token_budget] flat batches — ONE warm program
-    # for any length mix — instead of routing to bucket shapes
-    "score_impl": "bucketed",    # "bucketed" | "ragged"
+    # dispatch strategy (serving/dispatch.py): "ragged" packs each pull
+    # into fixed [1, token_budget] flat batches — ONE warm program for
+    # any length mix — instead of routing to bucket shapes
+    # (docs/ragged_serving.md); "continuous" admits requests into the
+    # in-flight pack persistently, decoupling queue wait from device
+    # latency (docs/serving.md, "Continuous admission")
+    "score_impl": "bucketed",    # "bucketed" | "ragged" | "continuous"
     "token_budget": None,        # ragged pack size (None → 4 × max_length)
     "max_rows_per_pack": None,   # ragged rows cap per pack (None → max_batch)
     "host": "127.0.0.1",     # HTTP front-end bind address

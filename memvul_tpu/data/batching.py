@@ -12,7 +12,9 @@ docs/training_throughput.md).  The ragged serve path replaces bucket
 padding entirely: :func:`pack_token_budget` packs variable-length
 requests into fixed ``[1, token_budget]`` flat batches and
 :func:`collate_ragged` emits the segment/position/row tables one warm
-program serves (docs/ragged_serving.md).  It also memoizes text→ids (CVE
+program serves (docs/ragged_serving.md); both ride
+:class:`PackSlotAllocator`, the reusable token-budget page table the
+continuous dispatcher admits into incrementally (serving/dispatch.py).  It also memoizes text→ids (CVE
 descriptions and anchors repeat heavily in the pair stream; hit/miss
 telemetry makes the memo auditable) and can prefetch batches on a
 background thread — optionally committing them to device there too (the
@@ -531,6 +533,121 @@ def pack_token_budget(
     return packs
 
 
+class PackSlotAllocator:
+    """A reusable token-budget page table of live segments — the
+    ``row_starts``/``segment_ids`` bookkeeping promoted out of
+    :func:`collate_ragged` so it can run *incrementally*.
+
+    :func:`collate_ragged` rebuilds the whole flat pack from scratch,
+    which is fine when a pull is sealed before collation.  The
+    continuous dispatcher (serving/dispatch.py) instead keeps a pack
+    *open* and admits requests one at a time while the previous pack is
+    on device, so the bookkeeping must support admission into a
+    half-built page: :meth:`admit` writes one segment in place (tokens,
+    mask, segment id, restarted positions, row start) and returns its
+    row index, or ``None`` when the segment does not fit the remaining
+    budget/rows — the caller's cue to seal the pack (:meth:`sample`)
+    and :meth:`reset` the pages for the next one.
+
+    The page arrays are allocated once and recycled across packs;
+    ``slots_reused`` counts admissions into a row slot a previous pack
+    already used (the ``serve.pack_slots_reused`` counter's source).
+    :meth:`sample` returns fresh copies with :func:`collate_ragged`'s
+    exact layout, so a sealed pack is safe to hand to the device while
+    the pages fill with the next pack's segments.
+    """
+
+    def __init__(self, token_budget: int, max_rows: int, pad_id: int) -> None:
+        if token_budget < 1:
+            raise ValueError(f"token_budget must be >= 1, got {token_budget}")
+        if max_rows < 1:
+            raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+        self.token_budget = int(token_budget)
+        self.max_rows = int(max_rows)
+        self.pad_id = pad_id
+        self._ids = np.full((1, self.token_budget), pad_id, dtype=np.int32)
+        self._mask = np.zeros((1, self.token_budget), dtype=np.int32)
+        self._segments = np.zeros((1, self.token_budget), dtype=np.int32)
+        self._positions = np.zeros((1, self.token_budget), dtype=np.int32)
+        self._row_starts = np.zeros(self.max_rows, dtype=np.int32)
+        self._rows = 0
+        self._offset = 0
+        self._real_tokens = 0
+        self._high_water = 0   # deepest row slot any sealed pack used
+        self._generation = 0   # completed reset() count
+        self.slots_reused = 0
+
+    @property
+    def rows(self) -> int:
+        """Live segments in the open pack."""
+        return self._rows
+
+    @property
+    def used_tokens(self) -> int:
+        """Token positions the open pack has written."""
+        return self._offset
+
+    @property
+    def real_tokens(self) -> int:
+        """Real (non-pad) tokens the open pack carries — the padding
+        ledger's numerator for this pack."""
+        return self._real_tokens
+
+    def fits(self, seq: Sequence[int]) -> bool:
+        """Whether :meth:`admit` would accept ``seq`` right now."""
+        n = min(len(seq), self.token_budget)
+        return self._rows < self.max_rows and self._offset + n <= self.token_budget
+
+    def admit(self, seq: Sequence[int]) -> Optional[int]:
+        """Write one segment into the open pack; returns its row index,
+        or ``None`` when it does not fit (seal + reset, then retry)."""
+        if not self.fits(seq):
+            return None
+        seq = seq[: self.token_budget]
+        n = len(seq)
+        row, offset = self._rows, self._offset
+        self._ids[0, offset : offset + n] = seq
+        self._mask[0, offset : offset + n] = 1
+        self._segments[0, offset : offset + n] = row + 1
+        self._positions[0, offset : offset + n] = np.arange(n, dtype=np.int32)
+        self._row_starts[row] = offset
+        self._rows = row + 1
+        self._offset = offset + n
+        self._real_tokens += n
+        if self._generation and row < self._high_water:
+            self.slots_reused += 1
+        return row
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """The open pack as the fixed-shape flat sample the ragged score
+        program consumes — fresh copies, so the pages can be recycled
+        while the device still reads the sealed pack."""
+        return {
+            "input_ids": self._ids.copy(),
+            "attention_mask": self._mask.copy(),
+            "segment_ids": self._segments.copy(),
+            "position_ids": self._positions.copy(),
+            "row_starts": self._row_starts.copy(),
+        }
+
+    def reset(self) -> None:
+        """Recycle the pages for the next pack: clear only the written
+        prefix (the untouched tail is already pad/zero)."""
+        offset, rows = self._offset, self._rows
+        if offset:
+            self._ids[0, :offset] = self.pad_id
+            self._mask[0, :offset] = 0
+            self._segments[0, :offset] = 0
+            self._positions[0, :offset] = 0
+        if rows:
+            self._row_starts[:rows] = 0
+        self._high_water = max(self._high_water, rows)
+        self._rows = 0
+        self._offset = 0
+        self._real_tokens = 0
+        self._generation += 1
+
+
 def collate_ragged(
     seqs: Sequence[List[int]],
     token_budget: int,
@@ -561,37 +678,22 @@ def collate_ragged(
     populated prefix depends only on the sequences themselves, so
     growing ``max_rows`` (more trailing dead rows) changes nothing a
     real row's score can see (pinned by the hypothesis suite).
+
+    The in-place bookkeeping lives in :class:`PackSlotAllocator`; this
+    is the one-shot wrapper: fill a fresh page table, return its sample.
     """
     if len(seqs) > max_rows:
         raise ValueError(f"{len(seqs)} rows exceed max_rows={max_rows}")
-    ids = np.full((1, token_budget), pad_id, dtype=np.int32)
-    mask = np.zeros((1, token_budget), dtype=np.int32)
-    segments = np.zeros((1, token_budget), dtype=np.int32)
-    positions = np.zeros((1, token_budget), dtype=np.int32)
-    row_starts = np.zeros(max_rows, dtype=np.int32)
-    offset = 0
+    alloc = PackSlotAllocator(token_budget, max_rows, pad_id)
     for i, seq in enumerate(seqs):
-        seq = seq[:token_budget]
-        n = len(seq)
-        if offset + n > token_budget:
+        if alloc.admit(seq) is None:
+            n = len(seq[:token_budget])
             raise ValueError(
                 f"pack overflows token_budget={token_budget} at row {i} "
-                f"(offset {offset} + {n} tokens) — pack with "
+                f"(offset {alloc.used_tokens} + {n} tokens) — pack with "
                 "pack_token_budget first"
             )
-        ids[0, offset : offset + n] = seq
-        mask[0, offset : offset + n] = 1
-        segments[0, offset : offset + n] = i + 1
-        positions[0, offset : offset + n] = np.arange(n, dtype=np.int32)
-        row_starts[i] = offset
-        offset += n
-    return {
-        "input_ids": ids,
-        "attention_mask": mask,
-        "segment_ids": segments,
-        "position_ids": positions,
-        "row_starts": row_starts,
-    }
+    return alloc.sample()
 
 
 def inflight_pipeline(

@@ -88,15 +88,16 @@ class SiamesePredictor:
         # program over a fixed [1, token_budget] packed batch replaces
         # the per-bucket program grid; warmup/scoring/swap all route on
         # this knob, so the bucketed contract is untouched by default
-        if score_impl not in ("bucketed", "ragged"):
+        if score_impl not in ("bucketed", "ragged", "continuous"):
             raise ValueError(
-                f"score_impl must be 'bucketed' or 'ragged', got {score_impl!r}"
+                f"score_impl must be 'bucketed', 'ragged' or 'continuous', "
+                f"got {score_impl!r}"
             )
-        if score_impl == "ragged" and mesh is not None:
+        if score_impl in ("ragged", "continuous") and mesh is not None:
             raise ValueError(
-                "score_impl='ragged' serves a single-device predictor (its "
-                "packed batch has one row); scale out with serving replicas, "
-                "not a mesh"
+                f"score_impl={score_impl!r} serves a single-device predictor "
+                "(its packed batch has one row); scale out with serving "
+                "replicas, not a mesh"
             )
         self.score_impl = score_impl
         if token_budget is None:
@@ -326,6 +327,15 @@ class SiamesePredictor:
         program compiles at — every pack dispatches this one shape."""
         return (self.token_budget, self.max_rows_per_pack)
 
+    @property
+    def uses_ragged_program(self) -> bool:
+        """Whether this predictor scores through the single packed
+        ``[1, token_budget]`` program — true for the ragged pull AND
+        the continuous-admission serve impl, which shares the warm
+        program and differs only in how the serving tier fills packs
+        (serving/dispatch.py)."""
+        return self.score_impl in ("ragged", "continuous")
+
     def _ragged_warm_sample(self) -> Dict[str, np.ndarray]:
         """A representative (content-irrelevant) pack at the warm
         geometry — what ``lower().compile()`` keys the executable on."""
@@ -342,16 +352,16 @@ class SiamesePredictor:
         before installing it, so a bank of a new geometry still never
         costs a mid-serve compile (docs/serving.md).
 
-        With ``score_impl="ragged"`` this warms exactly ONE program —
-        the packed ``[1, token_budget]`` score program that serves any
-        length mix — instead of the per-bucket grid
-        (docs/ragged_serving.md).  The bucketed ``score_instances``
+        With ``score_impl="ragged"`` or ``"continuous"`` this warms
+        exactly ONE program — the packed ``[1, token_budget]`` score
+        program that serves any length mix — instead of the per-bucket
+        grid (docs/ragged_serving.md).  The bucketed ``score_instances``
         path on such a predictor still works but compiles lazily."""
         # warmup (or a hot-swap re-warmup) legitimately traces: unlatch
         # the warm flag so those traces don't read as recompiles, then
         # re-latch once every warmed shape is registered
         self.programs.mark_warm("score", warm=False)
-        if self.score_impl == "ragged":
+        if self.uses_ragged_program:
             start = time.perf_counter()
             tel = get_registry()
             with tel.span("aot_warmup", shapes=1):
@@ -550,7 +560,7 @@ class SiamesePredictor:
             return np.zeros((0, n), np.float32)
         seqs = self.encoder.encode_many(list(texts))
         out = np.zeros((len(texts), n), np.float32)
-        if self.score_impl == "ragged":
+        if self.uses_ragged_program:
             from ..data.batching import collate_ragged, pack_token_budget
 
             budget, max_rows = self.token_budget, self.max_rows_per_pack

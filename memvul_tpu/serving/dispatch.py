@@ -1,0 +1,741 @@
+"""Dispatch strategies for the scoring service (docs/serving.md).
+
+PR 4's micro-batcher and PR 8's ragged path shared one dispatch loop by
+copy: the pull/deadline/drain/retry/hard-kill/trace semantics lived in
+``ScoringService`` twice over an ``if score_impl`` fork, and a third
+copy was the natural-but-wrong way to add continuous batching.  This
+module is the extraction: :class:`Dispatcher` owns those semantics ONCE
+— deadline expiry at pull, ONE bank snapshot per micro-batch, the
+``serve.batch`` fault point inside the retried window, dead-letter on
+retry exhaustion, hard-kill abandonment (resolve nothing, stay visible
+to the sweep), the trace waypoints, and the padding/occupancy ledger —
+and a strategy subclass decides only how accepted requests become
+device dispatches:
+
+* :class:`BucketedDispatcher` — PR 4: coalesce up to ``max_batch``
+  requests, route each to the smallest warmed (rows, length) bucket,
+  pad the block;
+* :class:`RaggedDispatcher` — PR 8: the same pull, packed by token
+  budget into fixed ``[1, token_budget]`` flat batches for the single
+  warmed segment-masked program (docs/ragged_serving.md);
+* :class:`ContinuousDispatcher` — this PR: no pull-then-seal at all.
+  A persistent admission loop pops requests the moment they arrive and
+  writes them straight into an open pack on a reusable
+  :class:`~memvul_tpu.data.batching.PackSlotAllocator` page table,
+  while a device worker thread scores sealed packs: pack N+1 tops up
+  *during* pack N's device round-trip, so ``serve.queue_wait_s``
+  decouples from device latency (ROADMAP's ≥3× p50 target).  The
+  overlap is measurable: ``serve.pack_topups`` counts admissions that
+  happened while the device was busy, ``serve.pack_slots_reused``
+  counts page-table slot recycling, and telemetry-report derives
+  ``serve.admission_efficiency`` from the pair.
+
+The admission-path discipline is machine-checked: MV102 extends to
+``*Dispatcher`` classes (no ``predict*``/``score_texts``/``time.sleep``
+between a pop and a dispatch), and MV301's blocking-under-lock rule
+covers the continuous dispatcher's two threads like every other
+thread-spawning class.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.batching import (
+    PackSlotAllocator,
+    _pad_block,
+    collate_ragged,
+    pack_token_budget,
+)
+from ..resilience import faults
+from ..resilience.retry import exception_text
+from .service import (
+    STATUS_DEADLINE,
+    STATUS_DRAIN,
+    STATUS_ERROR,
+    STATUS_OK,
+    _BankVersion,
+    _Request,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class Dispatcher:
+    """Strategy interface: the batcher-thread body of one
+    :class:`~memvul_tpu.serving.service.ScoringService`.
+
+    The base class IS the PR 4 contract — subclasses override only
+    :meth:`_dispatch_live` (how live requests become device chunks) and
+    inherit everything else.  :class:`ContinuousDispatcher` replaces
+    :meth:`run` wholesale but still scores through the shared
+    :meth:`_score_chunk` core, so the failure-routing and trace
+    semantics stay written once.
+    """
+
+    def __init__(self, service) -> None:
+        self.service = service
+
+    @property
+    def alive(self) -> bool:
+        """Dispatcher-internal liveness, AND-ed into the service's
+        ``batcher_alive`` health signal.  Single-threaded strategies run
+        entirely on the service's batcher thread (which the service
+        watches itself); the continuous strategy overrides this to watch
+        its device worker too."""
+        return True
+
+    # -- the batcher loop (service thread) -------------------------------------
+
+    def run(self) -> None:
+        svc = self.service
+        while not svc._draining.is_set():
+            pulled = self._pull_batch()
+            if not pulled:
+                continue
+            if svc._trace_enabled:
+                # one coalesce stamp + micro-batch id for the whole
+                # pull: these requests now share a fate until dispatch
+                # splits them into shape chunks
+                coalesced = time.monotonic()
+                batch = next(svc._batch_seq)
+                for request in pulled:
+                    if request.trace is not None:
+                        request.trace.coalesced = coalesced
+                        request.trace.batch = batch
+            # the pull is the in-flight work; track it so a hard kill's
+            # sweep can find requests that were popped but never resolved
+            with svc._cond:
+                svc._inflight = list(pulled)
+            if svc._killed.is_set():
+                return  # killed mid-pull: abandon (sweep will account)
+            # a pull that completed before the drain flag was seen is
+            # the in-flight work — it finishes (the trainer's
+            # finish-the-step contract); everything still queued sheds
+            self._dispatch(pulled)
+            if svc._killed.is_set():
+                return  # keep _inflight visible for take_unresolved
+            with svc._cond:
+                svc._inflight = []
+            svc._maybe_sample_hbm()
+            svc._tel.heartbeat()
+        if svc._killed.is_set():
+            return  # a killed worker resolves nothing
+        svc._shed_queue(STATUS_DRAIN)
+        svc._tel.event("serve_drained")
+        svc._tel.heartbeat(force=True)
+
+    def _pull_batch(self) -> List[_Request]:
+        """Coalesce up to ``max_batch`` requests: wait for the first,
+        then keep pulling until the flush window (``max_wait_ms`` after
+        the pull started) closes or the batch is full.  Waits are short
+        so the drain flag — which is set without taking the condition —
+        is noticed promptly."""
+        svc = self.service
+        cfg = svc.config
+        pulled: List[_Request] = []
+        while True:
+            with svc._cond:
+                if svc._queue:
+                    pulled.append(svc._queue.popleft())
+                    break
+                if svc._draining.is_set():
+                    return pulled
+                svc._cond.wait(0.05)
+            # idle liveness tick, OUTSIDE the queue lock (heartbeat may
+            # write HEARTBEAT.json, rate-limited): an idle-but-polling
+            # batcher keeps its heartbeat age near zero, so the router's
+            # missed-heartbeat eviction fires only on a genuinely wedged
+            # replica, never an unloaded one
+            svc._maybe_sample_hbm()
+            svc._tel.heartbeat()
+        flush_at = time.monotonic() + cfg.max_wait_ms / 1000.0
+        while len(pulled) < cfg.max_batch and not svc._draining.is_set():
+            remaining = flush_at - time.monotonic()
+            if remaining <= 0:
+                break
+            with svc._cond:
+                if not svc._queue:
+                    svc._cond.wait(min(remaining, 0.05))
+                if svc._queue:
+                    pulled.append(svc._queue.popleft())
+        with svc._cond:
+            svc._tel.gauge("serve.queue_depth").set(len(svc._queue))
+        return pulled
+
+    def _dispatch(self, pulled: List[_Request]) -> None:
+        """Score one coalesced pull: expire stale requests, snapshot the
+        bank ONCE, encode, and hand the live set to the strategy."""
+        svc = self.service
+        now = time.monotonic()
+        live: List[_Request] = []
+        for request in pulled:
+            if (
+                request.deadline_monotonic is not None
+                and now > request.deadline_monotonic
+            ):
+                svc._finish_unserved(request, STATUS_DEADLINE)
+            else:
+                live.append(request)
+        if not live:
+            return
+        with svc._bank_lock:
+            bank = svc._bank  # ONE snapshot for the whole pull
+        seqs = svc.predictor.encoder.encode_many([r.text for r in live])
+        svc._count_truncated(live, seqs)
+        self._dispatch_live(live, seqs, bank)
+
+    def _dispatch_live(
+        self,
+        live: List[_Request],
+        seqs: List[List[int]],
+        bank: _BankVersion,
+    ) -> None:
+        raise NotImplementedError
+
+    # -- the shared device-dispatch core ---------------------------------------
+
+    def _score_chunk(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        bank: _BankVersion,
+        *,
+        sample: Dict[str, Any],
+        occupancy_rows: int,
+        padded_tokens: int,
+        real_tokens: int,
+        score_fn,
+        shape: str,
+        program_key,
+    ) -> None:
+        """One device dispatch at a warmed shape.  The ``serve.batch``
+        fault point fires inside the retried window; retry exhaustion
+        (or a non-transient failure) dead-letters the chunk — every
+        request resolves ``"error"`` with the reason — rather than
+        hanging its clients."""
+        svc = self.service
+        tel = svc._tel
+
+        def once():
+            faults.fault_point("serve.batch")
+            return score_fn(svc.predictor.params, sample, bank.array)
+
+        if svc._trace_enabled:
+            # device_dispatch waypoint: tokenize/pad/pack is done, the
+            # device call is next — one stamp + shape label per chunk
+            dispatched = time.monotonic()
+            for request, _ in chunk:
+                if request.trace is not None:
+                    request.trace.dispatched = dispatched
+                    request.trace.shape = shape
+        start = time.perf_counter()
+        try:
+            if svc.retry_policy is None:
+                dev = once()
+            else:
+                dev = svc.retry_policy.call(once, description="serve batch")
+            probs = np.asarray(dev)[: len(chunk), : bank.n_anchors]
+        except Exception as e:
+            if svc._killed.is_set():
+                return  # a killed worker neither counts nor resolves
+            reason = exception_text(e)
+            logger.error(
+                "serve batch dead-lettered (%d request(s)): %s",
+                len(chunk), reason[:300],
+            )
+            tel.counter("serve.dead_letters").inc()
+            tel.counter("serve.errors").inc(len(chunk))
+            response = {"status": STATUS_ERROR, "reason": reason}
+            for request, _ in chunk:
+                request.future.resolve(dict(response))
+                svc._finish_trace(request, STATUS_ERROR)
+            return
+        if svc._killed.is_set():
+            return  # killed mid-dispatch: the sweep accounts this chunk
+        if svc._trace_enabled:
+            device_done = time.monotonic()
+            for request, _ in chunk:
+                if request.trace is not None:
+                    request.trace.device_done = device_done
+        tel.histogram("serve.batch_latency_s").observe(
+            time.perf_counter() - start
+        )
+        # program attribution: this dispatch ran one registered
+        # executable start-to-sync (np.asarray above blocks), so the
+        # elapsed window is the per-launch device time the roofline
+        # gauges divide by
+        # program_key is a thunk: duck-typed test fakes carry no program
+        # registry, so the key must not be computed unless one exists
+        programs = getattr(svc.predictor, "programs", None)
+        if programs is not None:
+            programs.record_invocation(
+                program_key(), time.perf_counter() - start
+            )
+        tel.histogram("serve.batch_occupancy").observe(
+            len(chunk) / occupancy_rows
+        )
+        # the padding-efficiency ledger (docs/ragged_serving.md):
+        # real tokens the requests carried vs token slots the dispatched
+        # shape paid for — telemetry-report derives
+        # serve.real_token_utilization from the pair, and the serve
+        # microbench A/B reads them per path
+        tel.counter("serve.tokens_real").inc(real_tokens)
+        tel.counter("serve.tokens_padded").inc(padded_tokens)
+        tel.counter("serve.batches").inc()
+        tel.counter("serve.served").inc(len(chunk))
+        tel.progress()
+        now = time.monotonic()
+        anchor_stats = svc.config.anchor_stats
+        for (request, _), row in zip(chunk, probs):
+            best = int(np.argmax(row))
+            tel.histogram("serve.latency_s").observe(
+                now - request.enqueued_monotonic
+            )
+            if anchor_stats:
+                # attribute the decision to its winning anchor — the
+                # per-anchor win/drift table's raw data (bankops/drift.py,
+                # docs/anchor_bank.md); ~one counter inc + one reservoir
+                # observe per response, bounded by the bank size
+                label = bank.labels[best]
+                tel.counter(f"bank.anchor_wins.{label}").inc()
+                tel.histogram(f"bank.anchor_score.{label}").observe(
+                    float(row[best])
+                )
+            request.future.resolve({
+                "status": STATUS_OK,
+                "predict": {
+                    label: float(p) for label, p in zip(bank.labels, row)
+                },
+                "score": float(row[best]),
+                "anchor": bank.labels[best],
+                "bank_version": bank.version,
+                "latency_ms": round(
+                    (now - request.enqueued_monotonic) * 1e3, 3
+                ),
+            })
+            trace = request.trace
+            if trace is not None:
+                # the four stage histograms partition enqueued→resolved
+                # exactly (docs/observability.md latency decomposition)
+                trace.resolved = now
+                if trace.coalesced is not None and trace.enqueued is not None:
+                    tel.histogram("serve.queue_wait_s").observe(
+                        trace.coalesced - trace.enqueued
+                    )
+                if trace.dispatched is not None and trace.coalesced is not None:
+                    tel.histogram("serve.pack_s").observe(
+                        trace.dispatched - trace.coalesced
+                    )
+                if trace.device_done is not None and trace.dispatched is not None:
+                    tel.histogram("serve.device_s").observe(
+                        trace.device_done - trace.dispatched
+                    )
+                if trace.device_done is not None:
+                    tel.histogram("serve.resolve_s").observe(
+                        now - trace.device_done
+                    )
+                svc._finish_trace(request, STATUS_OK)
+        tap = svc._shadow_tap
+        if tap is not None:
+            # after resolution, so shadow sampling never adds to client
+            # latency; the tap only enqueues copies, and a raising tap
+            # is counted — never client-visible (bankops/shadow.py)
+            try:
+                tap([request.text for request, _ in chunk], probs, bank)
+            except Exception:
+                tel.counter("bank.shadow_errors").inc()
+                logger.exception(
+                    "shadow tap failed (active path unaffected)"
+                )
+
+
+class BucketedDispatcher(Dispatcher):
+    """PR 4's strategy: route each live request to the smallest warmed
+    (rows, length) bucket covering its token count and pad every chunk
+    to the warmed block shape — a served score is bitwise-identical to
+    the offline score of the same text."""
+
+    def _dispatch_live(
+        self,
+        live: List[_Request],
+        seqs: List[List[int]],
+        bank: _BankVersion,
+    ) -> None:
+        svc = self.service
+        groups: Dict[int, List[Tuple[_Request, List[int]]]] = {}
+        for request, seq in zip(live, seqs):
+            groups.setdefault(self._bucket_for(len(seq)), []).append(
+                (request, seq)
+            )
+        for length in sorted(groups):
+            rows = svc._rows_by_length[length]
+            group = groups[length]
+            for start in range(0, len(group), rows):
+                if svc._killed.is_set():
+                    return  # abandoned — the kill sweep takes over
+                chunk = group[start : start + rows]
+                sample = _pad_block(
+                    [seq for _, seq in chunk], rows,
+                    svc.predictor.encoder.pad_id, length,
+                )
+                if svc.predictor.mesh is not None:
+                    from ..parallel.mesh import shard_batch
+
+                    sample = shard_batch(sample, svc.predictor.mesh)
+                self._score_chunk(
+                    chunk, bank,
+                    sample=sample,
+                    occupancy_rows=rows,
+                    padded_tokens=rows * length,
+                    real_tokens=sum(
+                        min(len(seq), length) for _, seq in chunk
+                    ),
+                    score_fn=svc.predictor._score_fn,
+                    shape=f"bucket:{rows}x{length} fill={len(chunk)}/{rows}",
+                    program_key=lambda rows=rows, length=length: (
+                        svc.predictor.bucket_program_key(rows, length)
+                    ),
+                )
+
+    def _bucket_for(self, n_tokens: int) -> int:
+        """Smallest warmed bucket covering the token count (over-long
+        texts truncate into the largest bucket, matching the offline
+        collator's ``seq[:length]``)."""
+        for length in self.service._lengths:
+            if length >= n_tokens:
+                return length
+        return self.service._lengths[-1]
+
+
+class RaggedDispatcher(Dispatcher):
+    """PR 8's strategy: coalesce by token budget, not rows-per-bucket —
+    the pull is packed into as few fixed-``[1, token_budget]`` batches
+    as the greedy in-order packer allows, and ONE warm segment-masked
+    program serves any length mix (docs/ragged_serving.md)."""
+
+    def _dispatch_live(
+        self,
+        live: List[_Request],
+        seqs: List[List[int]],
+        bank: _BankVersion,
+    ) -> None:
+        svc = self.service
+        budget, max_rows = svc._token_budget, svc._max_rows
+        for pack in pack_token_budget(
+            [len(seq) for seq in seqs], budget, max_rows
+        ):
+            if svc._killed.is_set():
+                return  # abandoned — the kill sweep takes over
+            chunk = [(live[i], seqs[i]) for i in pack]
+            real_tokens = sum(min(len(seq), budget) for _, seq in chunk)
+            self._score_chunk(
+                chunk, bank,
+                sample=collate_ragged(
+                    [seq for _, seq in chunk], budget, max_rows,
+                    svc.predictor.encoder.pad_id,
+                ),
+                occupancy_rows=max_rows,
+                padded_tokens=budget,
+                real_tokens=real_tokens,
+                score_fn=svc.predictor._ragged_score_fn,
+                shape=f"pack:{real_tokens}/{budget}",
+                program_key=lambda: svc.predictor.ragged_program_key(),
+            )
+
+
+class _SealedPack:
+    """One sealed pack in the admission→device handoff: the rows, the
+    collated sample (already copied off the page table), the padding
+    ledger numerator, and the ONE bank snapshot the whole pack serves
+    from."""
+
+    __slots__ = ("chunk", "sample", "real_tokens", "bank")
+
+    def __init__(self, chunk, sample, real_tokens, bank) -> None:
+        self.chunk = chunk
+        self.sample = sample
+        self.real_tokens = real_tokens
+        self.bank = bank
+
+
+class ContinuousDispatcher(Dispatcher):
+    """Continuous batching: persistent admission into the in-flight
+    pack (docs/serving.md, "Continuous admission").
+
+    Two threads replace the pull-then-seal loop:
+
+    * the service's batcher thread runs the **admission loop**: it pops
+      each request the moment it arrives (deadline checked at the pop —
+      the same expire-at-pull semantics as the other strategies),
+      encodes it, and writes it straight into the open pack on the
+      reusable :class:`PackSlotAllocator` page table.  The pack seals
+      when it is full (budget or rows) or when its oldest row has
+      waited ``max_wait_ms``, and is handed to
+    * a **device worker thread**, which scores sealed packs through the
+      shared :meth:`Dispatcher._score_chunk` core (same fault point,
+      retry, dead-letter, trace and ledger semantics).
+
+    While pack N is on device the admission loop keeps filling pack
+    N+1 — ``serve.pack_topups`` counts exactly those overlapped
+    admissions — so a request's queue wait is the pop latency, not a
+    device round-trip.  The handoff queue holds at most one sealed
+    pack: one pack on device + one sealed + one filling bounds memory
+    and keeps backpressure honest (when all three are full, requests
+    age in the service queue and expire at the pop, never inside a
+    pack).
+
+    Hard-kill and drain keep the service's contract: a kill abandons
+    the open pack, the handoff, and the on-device pack unresolved (all
+    still visible to ``take_unresolved`` via the service's in-flight
+    list, which this strategy maintains incrementally); a drain seals
+    and finishes the open pack — it is pulled work — then sheds the
+    queue with ``"drain"``.
+    """
+
+    def __init__(self, service) -> None:
+        super().__init__(service)
+        predictor = service.predictor
+        self._token_budget = service._token_budget
+        self._max_rows = service._max_rows
+        self._alloc = PackSlotAllocator(
+            self._token_budget, self._max_rows, predictor.encoder.pad_id
+        )
+        # admission-thread-only state (never touched by the worker)
+        self._open: List[Tuple[_Request, List[int]]] = []
+        self._flush_at: Optional[float] = None
+        self._slots_reported = 0
+        # cross-thread state: plain objects with their own synchronization
+        self._handoff: "queue.Queue[Optional[_SealedPack]]" = queue.Queue(
+            maxsize=1
+        )
+        self._device_busy = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    @property
+    def alive(self) -> bool:
+        worker = self._worker
+        if worker is None:
+            return True  # not started yet (construction window)
+        # a worker that exited outside a drain/kill is a dead replica —
+        # the admission loop may still spin, but nothing scores
+        return worker.is_alive() or self.service._draining.is_set()
+
+    def run(self) -> None:
+        svc = self.service
+        worker = threading.Thread(
+            target=self._device_loop,
+            name="memvul-serve-device",
+            daemon=True,
+        )
+        # start-then-publish: ``alive`` treats a None worker as healthy
+        # (construction window), but a published-yet-unstarted thread
+        # would read as dead to a concurrent health probe
+        worker.start()
+        self._worker = worker
+        while not svc._draining.is_set():
+            request = None
+            with svc._cond:
+                if svc._queue:
+                    request = svc._queue.popleft()
+                    svc._tel.gauge("serve.queue_depth").set(len(svc._queue))
+                else:
+                    timeout = 0.05
+                    if self._flush_at is not None:
+                        timeout = min(
+                            timeout,
+                            max(self._flush_at - time.monotonic(), 0.0),
+                        )
+                    if timeout > 0:
+                        svc._cond.wait(timeout)
+            if request is not None:
+                self._admit(request)
+                if svc._killed.is_set():
+                    return  # abandon — the kill sweep takes over
+            else:
+                # idle liveness tick, OUTSIDE the queue lock (heartbeat
+                # may write HEARTBEAT.json, rate-limited) — same
+                # contract as the pull loop's idle wait
+                svc._maybe_sample_hbm()
+                svc._tel.heartbeat()
+            if (
+                self._open
+                and self._flush_at is not None
+                and time.monotonic() >= self._flush_at
+            ):
+                self._seal_and_submit()
+                if svc._killed.is_set():
+                    return
+        # drain: the admitted-but-unsealed pack is pulled work — it
+        # finishes (the trainer's finish-the-step contract)
+        if not svc._killed.is_set() and self._open:
+            self._seal_and_submit()
+        self._stop_worker(worker)
+        if svc._killed.is_set():
+            return  # a killed worker resolves nothing
+        svc._shed_queue(STATUS_DRAIN)
+        svc._tel.event("serve_drained")
+        svc._tel.heartbeat(force=True)
+
+    # -- admission loop (service batcher thread) -------------------------------
+
+    def _admit(self, request: _Request) -> None:
+        """One pop → one page-table write.  Deadline-at-pull happens
+        here: a request that expired while queued resolves
+        ``"deadline"`` and never touches the pack."""
+        svc = self.service
+        now = time.monotonic()
+        if (
+            request.deadline_monotonic is not None
+            and now > request.deadline_monotonic
+        ):
+            svc._finish_unserved(request, STATUS_DEADLINE)
+            return
+        seq = svc.predictor.encoder.encode_many([request.text])[0]
+        svc._count_truncated([request], [seq])
+        # in-flight the moment it leaves the queue: a hard kill's sweep
+        # must find popped-but-unresolved requests wherever they sit —
+        # open pack, handoff, or on device
+        with svc._cond:
+            svc._inflight.append(request)
+        if request.trace is not None:
+            # admission into the pack IS the coalesce waypoint — with
+            # continuous admission, enqueued→coalesced (queue_wait) is
+            # the pop latency, decoupled from the device round-trip
+            request.trace.coalesced = now
+        row = self._alloc.admit(seq)
+        if row is None:
+            self._seal_and_submit()
+            if svc._killed.is_set():
+                return
+            row = self._alloc.admit(seq)
+            assert row is not None, "cap-length request must fit an empty pack"
+        if self._device_busy.is_set():
+            # the decoupling at work: this request joined pack N+1 while
+            # pack N was on device — it never waited a round-trip
+            svc._tel.counter("serve.pack_topups").inc()
+        if not self._open:
+            self._flush_at = (
+                time.monotonic() + svc.config.max_wait_ms / 1000.0
+            )
+        self._open.append((request, seq))
+        if self._alloc.rows >= self._max_rows:
+            self._seal_and_submit()
+
+    def _seal_and_submit(self) -> None:
+        """Seal the open pack: snapshot the bank (ONE per micro-batch —
+        the no-torn-mix guarantee), copy the sample off the page table,
+        recycle the slots, and hand the pack to the device worker.
+        Blocks — in short, kill-aware steps — only when a sealed pack is
+        already waiting behind the one on device."""
+        if not self._open:
+            return
+        svc = self.service
+        with svc._bank_lock:
+            bank = svc._bank
+        chunk, self._open = self._open, []
+        self._flush_at = None
+        sample = self._alloc.sample()
+        real_tokens = self._alloc.real_tokens
+        self._alloc.reset()
+        reused = self._alloc.slots_reused - self._slots_reported
+        if reused:
+            self._slots_reported = self._alloc.slots_reused
+            svc._tel.counter("serve.pack_slots_reused").inc(reused)
+        if svc._trace_enabled:
+            batch = next(svc._batch_seq)
+            for request, _ in chunk:
+                if request.trace is not None:
+                    request.trace.batch = batch
+        item = _SealedPack(chunk, sample, real_tokens, bank)
+        while True:
+            if svc._killed.is_set():
+                return  # abandon unresolved; the sweep accounts them
+            try:
+                self._handoff.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue  # backpressure: device + handoff both full
+
+    def _stop_worker(self, worker: threading.Thread) -> None:
+        """Deliver the shutdown sentinel behind any still-queued pack,
+        then wait for the worker to finish it."""
+        svc = self.service
+        while worker.is_alive():
+            if svc._killed.is_set():
+                # a killed worker exits on its own killed checks; if the
+                # handoff is full, the queued pack wakes it
+                try:
+                    self._handoff.put_nowait(None)
+                except queue.Full:
+                    pass
+                break
+            try:
+                self._handoff.put(None, timeout=0.05)
+                break
+            except queue.Full:
+                continue
+        worker.join(timeout=30.0)
+
+    # -- device worker thread --------------------------------------------------
+
+    def _device_loop(self) -> None:
+        svc = self.service
+        while True:
+            try:
+                item = self._handoff.get(timeout=0.5)
+            except queue.Empty:
+                if svc._killed.is_set():
+                    return
+                continue
+            if item is None:
+                return  # drain sentinel
+            if svc._killed.is_set():
+                return  # abandon unresolved (still in the in-flight list)
+            self._device_busy.set()
+            try:
+                self._score_chunk(
+                    item.chunk, item.bank,
+                    sample=item.sample,
+                    occupancy_rows=self._max_rows,
+                    padded_tokens=self._token_budget,
+                    real_tokens=item.real_tokens,
+                    score_fn=svc.predictor._ragged_score_fn,
+                    shape=f"pack:{item.real_tokens}/{self._token_budget}",
+                    program_key=lambda: svc.predictor.ragged_program_key(),
+                )
+            finally:
+                self._device_busy.clear()
+            if svc._killed.is_set():
+                return  # keep the in-flight list visible for the sweep
+            with svc._cond:
+                svc._inflight = [
+                    r for r in svc._inflight if not r.future.done()
+                ]
+
+
+_DISPATCHERS = {
+    "bucketed": BucketedDispatcher,
+    "ragged": RaggedDispatcher,
+    "continuous": ContinuousDispatcher,
+}
+
+
+def make_dispatcher(service) -> Dispatcher:
+    """The strategy for the service's predictor ``score_impl`` —
+    ``bucketed`` (PR 4), ``ragged`` (PR 8) or ``continuous`` (this
+    module).  The predictor has already validated the knob; this is the
+    belt-and-braces for duck-typed test fakes."""
+    impl = service._score_impl
+    try:
+        return _DISPATCHERS[impl](service)
+    except KeyError:
+        raise ValueError(
+            f"unknown score_impl {impl!r} "
+            f"(known: {sorted(_DISPATCHERS)})"
+        ) from None

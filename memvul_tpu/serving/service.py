@@ -18,11 +18,16 @@ Three cooperating pieces:
   to the smallest warmed length bucket covering its token count, and
   pads every micro-batch to the warmed (rows, bucket) shape with the
   same ``_pad_block`` the offline collator uses — so a served score is
-  bitwise-identical to the offline score of the same text.  With a
+  bitwise-identical to the offline score of the same text.  The batcher
+  body is a strategy (serving/dispatch.py): with a
   ``score_impl="ragged"`` predictor the pull instead coalesces by
   token budget: it is packed into fixed ``[1, token_budget]`` flat
   batches and ONE warmed segment-masked program serves any length mix
-  (scores ≤1e-6 vs the bucketed path; docs/ragged_serving.md);
+  (scores ≤1e-6 vs the bucketed path; docs/ragged_serving.md); with
+  ``score_impl="continuous"`` there is no pull at all — a persistent
+  admission loop writes each request straight into the open pack while
+  the previous pack is on device, decoupling queue wait from device
+  latency (docs/serving.md, "Continuous admission");
 * **admission control** — the queue is bounded (``max_queue``); on
   overflow the *oldest* queued request is shed (it is the one most
   likely to miss its deadline anyway) with status ``"shed"`` instead of
@@ -72,11 +77,7 @@ import time
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
-import numpy as np
-
-from ..data.batching import _pad_block
-from ..resilience import faults
-from ..resilience.retry import RetryPolicy, exception_text
+from ..resilience.retry import RetryPolicy
 from ..telemetry import get_registry
 
 logger = logging.getLogger(__name__)
@@ -298,13 +299,15 @@ class ScoringService:
             length: rows for rows, length in predictor.stream_shapes()
         }
         self._lengths = sorted(self._rows_by_length)
-        # ragged serve path (docs/ragged_serving.md): the predictor's
-        # score_impl decides how a pull dispatches — bucket routing over
-        # the warmed grid, or token-budget packing into the single
-        # warmed [1, token_budget] program.  Admission, deadlines,
-        # drain, swap and the shadow tap are impl-independent.
+        # dispatch strategy (serving/dispatch.py): the predictor's
+        # score_impl decides how accepted requests become device
+        # dispatches — bucket routing over the warmed grid ("bucketed"),
+        # token-budget packing into the single warmed [1, token_budget]
+        # program ("ragged"), or persistent admission into the in-flight
+        # pack ("continuous").  Admission, deadlines, drain, swap and
+        # the shadow tap are impl-independent and stay here.
         self._score_impl = getattr(predictor, "score_impl", "bucketed")
-        if self._score_impl == "ragged":
+        if self._score_impl in ("ragged", "continuous"):
             self._token_budget, self._max_rows = predictor.ragged_shape()
         else:
             self._token_budget = self._max_rows = 0
@@ -357,8 +360,15 @@ class ScoringService:
         self._device = device
         self._hbm_next_monotonic = 0.0
         self._write_manifest()
+        # the strategy owns the batcher body; imported lazily because
+        # dispatch.py imports this module's status constants
+        from .dispatch import make_dispatcher
+
+        self._dispatcher = make_dispatcher(self)
         self._thread = threading.Thread(
-            target=self._loop, name="memvul-serve-batcher", daemon=True
+            target=self._dispatcher.run,
+            name="memvul-serve-batcher",
+            daemon=True,
         )
         self._thread.start()
 
@@ -458,10 +468,12 @@ class ScoringService:
 
     @property
     def batcher_alive(self) -> bool:
-        """Whether the batcher thread is running (a replica health
-        signal: a batcher that exited without a drain is a dead
-        replica)."""
-        return self._thread.is_alive()
+        """Whether the batcher is running (a replica health signal: a
+        batcher that exited without a drain is a dead replica).  The
+        dispatcher's own liveness is AND-ed in — the continuous
+        strategy's device worker dying mid-serve is just as dead as the
+        batcher thread itself, even while admission still spins."""
+        return self._thread.is_alive() and self._dispatcher.alive
 
     @property
     def default_deadline_ms(self) -> float:
@@ -481,6 +493,10 @@ class ScoringService:
             "status": "draining" if draining else "ok",
             "draining": draining,
             "queue_depth": self.queue_depth,
+            # which dispatch strategy serves this replica — the fleet
+            # view surfaces it so a mixed rollout (ragged → continuous)
+            # is observable per member (serving/dispatch.py)
+            "score_impl": self._score_impl,
             "bank_version": bank.version,
             # provenance row: fleet state is traceable to a store
             # version + how it got installed (docs/anchor_bank.md)
@@ -715,141 +731,12 @@ class ScoringService:
         )
 
     # -- batcher thread --------------------------------------------------------
-
-    def _loop(self) -> None:
-        while not self._draining.is_set():
-            pulled = self._pull_batch()
-            if not pulled:
-                continue
-            if self._trace_enabled:
-                # one coalesce stamp + micro-batch id for the whole
-                # pull: these requests now share a fate until dispatch
-                # splits them into shape chunks
-                coalesced = time.monotonic()
-                batch = next(self._batch_seq)
-                for request in pulled:
-                    if request.trace is not None:
-                        request.trace.coalesced = coalesced
-                        request.trace.batch = batch
-            # the pull is the in-flight work; track it so a hard kill's
-            # sweep can find requests that were popped but never resolved
-            with self._cond:
-                self._inflight = list(pulled)
-            if self._killed.is_set():
-                return  # killed mid-pull: abandon (sweep will account)
-            # a pull that completed before the drain flag was seen is
-            # the in-flight work — it finishes (the trainer's
-            # finish-the-step contract); everything still queued sheds
-            self._dispatch(pulled)
-            if self._killed.is_set():
-                return  # keep _inflight visible for take_unresolved
-            with self._cond:
-                self._inflight = []
-            self._maybe_sample_hbm()
-            self._tel.heartbeat()
-        if self._killed.is_set():
-            return  # a killed worker resolves nothing
-        self._shed_queue(STATUS_DRAIN)
-        self._tel.event("serve_drained")
-        self._tel.heartbeat(force=True)
-
-    def _pull_batch(self) -> List[_Request]:
-        """Coalesce up to ``max_batch`` requests: wait for the first,
-        then keep pulling until the flush window (``max_wait_ms`` after
-        the pull started) closes or the batch is full.  Waits are short
-        so the drain flag — which is set without taking the condition —
-        is noticed promptly."""
-        cfg = self.config
-        pulled: List[_Request] = []
-        while True:
-            with self._cond:
-                if self._queue:
-                    pulled.append(self._queue.popleft())
-                    break
-                if self._draining.is_set():
-                    return pulled
-                self._cond.wait(0.05)
-            # idle liveness tick, OUTSIDE the queue lock (heartbeat may
-            # write HEARTBEAT.json, rate-limited): an idle-but-polling
-            # batcher keeps its heartbeat age near zero, so the router's
-            # missed-heartbeat eviction fires only on a genuinely wedged
-            # replica, never an unloaded one
-            self._maybe_sample_hbm()
-            self._tel.heartbeat()
-        flush_at = time.monotonic() + cfg.max_wait_ms / 1000.0
-        while len(pulled) < cfg.max_batch and not self._draining.is_set():
-            remaining = flush_at - time.monotonic()
-            if remaining <= 0:
-                break
-            with self._cond:
-                if not self._queue:
-                    self._cond.wait(min(remaining, 0.05))
-                if self._queue:
-                    pulled.append(self._queue.popleft())
-        with self._cond:
-            self._tel.gauge("serve.queue_depth").set(len(self._queue))
-        return pulled
-
-    def _dispatch(self, pulled: List[_Request]) -> None:
-        """Score one coalesced pull: expire stale requests, route the
-        rest to their warmed bucket shapes, resolve every future."""
-        now = time.monotonic()
-        live: List[_Request] = []
-        for request in pulled:
-            if (
-                request.deadline_monotonic is not None
-                and now > request.deadline_monotonic
-            ):
-                self._finish_unserved(request, STATUS_DEADLINE)
-            else:
-                live.append(request)
-        if not live:
-            return
-        with self._bank_lock:
-            bank = self._bank  # ONE snapshot for the whole pull
-        encoder = self.predictor.encoder
-        seqs = encoder.encode_many([r.text for r in live])
-        self._count_truncated(live, seqs)
-        if self._score_impl == "ragged":
-            # coalesce by token budget, not rows-per-bucket: the pull is
-            # packed into as few fixed-[1, token_budget] batches as the
-            # greedy in-order packer allows — one warm program serves
-            # any length mix (docs/ragged_serving.md)
-            from ..data.batching import pack_token_budget
-
-            for pack in pack_token_budget(
-                [len(seq) for seq in seqs],
-                self._token_budget, self._max_rows,
-            ):
-                if self._killed.is_set():
-                    return  # abandoned — the kill sweep takes over
-                self._score_chunk(
-                    [(live[i], seqs[i]) for i in pack], bank
-                )
-            return
-        groups: Dict[int, List[Tuple[_Request, List[int]]]] = {}
-        for request, seq in zip(live, seqs):
-            groups.setdefault(self._bucket_for(len(seq)), []).append(
-                (request, seq)
-            )
-        for length in sorted(groups):
-            rows = self._rows_by_length[length]
-            group = groups[length]
-            for start in range(0, len(group), rows):
-                if self._killed.is_set():
-                    return  # abandoned — the kill sweep takes over
-                self._score_chunk(
-                    group[start : start + rows], bank, length=length, rows=rows
-                )
-
-    def _bucket_for(self, n_tokens: int) -> int:
-        """Smallest warmed bucket covering the token count (over-long
-        texts truncate into the largest bucket, matching the offline
-        collator's ``seq[:length]``)."""
-        for length in self._lengths:
-            if length >= n_tokens:
-                return length
-        return self._lengths[-1]
+    #
+    # The batcher body lives in serving/dispatch.py: the service thread
+    # runs ``self._dispatcher.run()``, and the strategy (bucketed /
+    # ragged / continuous) decides how accepted requests become device
+    # dispatches.  Admission, deadlines, drain, swap, kill and the
+    # shadow tap stay here — impl-independent.
 
     def _count_truncated(self, live: Sequence[_Request], seqs) -> None:
         """``serve.truncated``: requests whose text tokenized PAST the
@@ -862,7 +749,7 @@ class ScoringService:
         if probe is None or not seqs:
             return
         cap = self.predictor.encoder.max_length
-        if self._score_impl == "ragged":
+        if self._score_impl in ("ragged", "continuous"):
             cap = min(cap, self._token_budget)
         truncated = sum(
             1
@@ -871,185 +758,6 @@ class ScoringService:
         )
         if truncated:
             self._tel.counter("serve.truncated").inc(truncated)
-
-    def _score_chunk(
-        self,
-        chunk: Sequence[Tuple[_Request, List[int]]],
-        bank: _BankVersion,
-        length: Optional[int] = None,
-        rows: Optional[int] = None,
-    ) -> None:
-        """One device dispatch at a warmed shape — a (rows, length)
-        bucket block, or (ragged) one packed [1, token_budget] batch.
-        The ``serve.batch`` fault point fires inside the retried window;
-        retry exhaustion (or a non-transient failure) dead-letters the
-        chunk — every request resolves ``"error"`` with the reason —
-        rather than hanging its clients."""
-        from ..parallel.mesh import shard_batch
-
-        tel = self._tel
-        if self._score_impl == "ragged":
-            from ..data.batching import collate_ragged
-
-            sample = collate_ragged(
-                [seq for _, seq in chunk], self._token_budget,
-                self._max_rows, self.predictor.encoder.pad_id,
-            )
-            occupancy_rows = self._max_rows
-            padded_tokens = self._token_budget
-            real_tokens = sum(
-                min(len(seq), self._token_budget) for _, seq in chunk
-            )
-            score_fn = self.predictor._ragged_score_fn
-        else:
-            sample = _pad_block(
-                [seq for _, seq in chunk], rows,
-                self.predictor.encoder.pad_id, length,
-            )
-            if self.predictor.mesh is not None:
-                sample = shard_batch(sample, self.predictor.mesh)
-            occupancy_rows = rows
-            padded_tokens = rows * length
-            real_tokens = sum(min(len(seq), length) for _, seq in chunk)
-            score_fn = self.predictor._score_fn
-
-        def once():
-            faults.fault_point("serve.batch")
-            return score_fn(self.predictor.params, sample, bank.array)
-
-        if self._trace_enabled:
-            # device_dispatch waypoint: tokenize/pad/pack is done, the
-            # device call is next — one stamp + shape label per chunk
-            dispatched = time.monotonic()
-            shape = (
-                f"pack:{real_tokens}/{padded_tokens}"
-                if self._score_impl == "ragged"
-                else f"bucket:{rows}x{length} fill={len(chunk)}/{rows}"
-            )
-            for request, _ in chunk:
-                if request.trace is not None:
-                    request.trace.dispatched = dispatched
-                    request.trace.shape = shape
-        start = time.perf_counter()
-        try:
-            if self.retry_policy is None:
-                dev = once()
-            else:
-                dev = self.retry_policy.call(once, description="serve batch")
-            probs = np.asarray(dev)[: len(chunk), : bank.n_anchors]
-        except Exception as e:
-            if self._killed.is_set():
-                return  # a killed worker neither counts nor resolves
-            reason = exception_text(e)
-            logger.error(
-                "serve batch dead-lettered (%d request(s)): %s",
-                len(chunk), reason[:300],
-            )
-            tel.counter("serve.dead_letters").inc()
-            tel.counter("serve.errors").inc(len(chunk))
-            response = {"status": STATUS_ERROR, "reason": reason}
-            for request, _ in chunk:
-                request.future.resolve(dict(response))
-                self._finish_trace(request, STATUS_ERROR)
-            return
-        if self._killed.is_set():
-            return  # killed mid-dispatch: the sweep accounts this chunk
-        if self._trace_enabled:
-            device_done = time.monotonic()
-            for request, _ in chunk:
-                if request.trace is not None:
-                    request.trace.device_done = device_done
-        tel.histogram("serve.batch_latency_s").observe(
-            time.perf_counter() - start
-        )
-        # program attribution: this dispatch ran one registered
-        # executable start-to-sync (np.asarray above blocks), so the
-        # elapsed window is the per-launch device time the roofline
-        # gauges divide by
-        programs = getattr(self.predictor, "programs", None)
-        if programs is not None:
-            programs.record_invocation(
-                self.predictor.ragged_program_key()
-                if self._score_impl == "ragged"
-                else self.predictor.bucket_program_key(rows, length),
-                time.perf_counter() - start,
-            )
-        tel.histogram("serve.batch_occupancy").observe(
-            len(chunk) / occupancy_rows
-        )
-        # the padding-efficiency ledger (docs/ragged_serving.md):
-        # real tokens the requests carried vs token slots the dispatched
-        # shape paid for — telemetry-report derives
-        # serve.real_token_utilization from the pair, and the serve
-        # microbench A/B reads them per path
-        tel.counter("serve.tokens_real").inc(real_tokens)
-        tel.counter("serve.tokens_padded").inc(padded_tokens)
-        tel.counter("serve.batches").inc()
-        tel.counter("serve.served").inc(len(chunk))
-        tel.progress()
-        now = time.monotonic()
-        anchor_stats = self.config.anchor_stats
-        for (request, _), row in zip(chunk, probs):
-            best = int(np.argmax(row))
-            tel.histogram("serve.latency_s").observe(
-                now - request.enqueued_monotonic
-            )
-            if anchor_stats:
-                # attribute the decision to its winning anchor — the
-                # per-anchor win/drift table's raw data (bankops/drift.py,
-                # docs/anchor_bank.md); ~one counter inc + one reservoir
-                # observe per response, bounded by the bank size
-                label = bank.labels[best]
-                tel.counter(f"bank.anchor_wins.{label}").inc()
-                tel.histogram(f"bank.anchor_score.{label}").observe(
-                    float(row[best])
-                )
-            request.future.resolve({
-                "status": STATUS_OK,
-                "predict": {
-                    label: float(p) for label, p in zip(bank.labels, row)
-                },
-                "score": float(row[best]),
-                "anchor": bank.labels[best],
-                "bank_version": bank.version,
-                "latency_ms": round(
-                    (now - request.enqueued_monotonic) * 1e3, 3
-                ),
-            })
-            trace = request.trace
-            if trace is not None:
-                # the four stage histograms partition enqueued→resolved
-                # exactly (docs/observability.md latency decomposition)
-                trace.resolved = now
-                if trace.coalesced is not None and trace.enqueued is not None:
-                    tel.histogram("serve.queue_wait_s").observe(
-                        trace.coalesced - trace.enqueued
-                    )
-                if trace.dispatched is not None and trace.coalesced is not None:
-                    tel.histogram("serve.pack_s").observe(
-                        trace.dispatched - trace.coalesced
-                    )
-                if trace.device_done is not None and trace.dispatched is not None:
-                    tel.histogram("serve.device_s").observe(
-                        trace.device_done - trace.dispatched
-                    )
-                if trace.device_done is not None:
-                    tel.histogram("serve.resolve_s").observe(
-                        now - trace.device_done
-                    )
-                self._finish_trace(request, STATUS_OK)
-        tap = self._shadow_tap
-        if tap is not None:
-            # after resolution, so shadow sampling never adds to client
-            # latency; the tap only enqueues copies, and a raising tap
-            # is counted — never client-visible (bankops/shadow.py)
-            try:
-                tap([request.text for request, _ in chunk], probs, bank)
-            except Exception:
-                tel.counter("bank.shadow_errors").inc()
-                logger.exception(
-                    "shadow tap failed (active path unaffected)"
-                )
 
     # -- shed / drain resolution ----------------------------------------------
 

@@ -422,6 +422,13 @@ def _derived_metrics(counters: Dict[str, Any]) -> Dict[str, float]:
     padded = _as_num(counters.get("serve.tokens_padded"))
     if padded > 0:
         out["serve.real_token_utilization"] = real / padded
+    topups = _as_num(counters.get("serve.pack_topups"))
+    served = _as_num(counters.get("serve.served"))
+    if topups > 0 and served > 0:
+        # continuous admission only: the fraction of served requests
+        # that joined a pack while the device was busy with another —
+        # how much of the load actually overlapped the round-trip
+        out["serve.admission_efficiency"] = topups / served
     return out
 
 
@@ -617,6 +624,19 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
             lines.append(
                 f"  serve.real_token_utilization = {real / padded:.3f}"
                 f" ({int(real)}/{int(padded)} token slots)"
+            )
+        # derived: continuous-admission overlap — how much of the served
+        # load joined a pack while the device was busy with another
+        # (continuous dispatcher only; docs/serving.md)
+        try:
+            topups = float(counters["serve.pack_topups"])
+            served = float(counters["serve.served"])
+        except (KeyError, TypeError, ValueError):
+            topups = served = 0.0
+        if topups > 0 and served > 0:
+            lines.append(
+                f"  serve.admission_efficiency = {topups / served:.3f}"
+                f" ({int(topups)}/{int(served)} served admitted mid-flight)"
             )
     gauges = summary.get("gauges") or {}
     if gauges:

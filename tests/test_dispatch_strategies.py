@@ -1,0 +1,455 @@
+"""Dispatcher strategy contract (memvul_tpu/serving/dispatch.py).
+
+PR 4's dispatch semantics were extracted into a strategy interface so
+``bucketed``, ``ragged``, and ``continuous`` inherit them from ONE
+implementation.  This file pins that the contract actually holds for
+ALL THREE through one shared harness:
+
+* **exact-counter invariant** — under a blocked device, queue overflow,
+  and expiring deadlines, every per-status response count equals its
+  telemetry sub-counter and ``served + shed + errors == requests``;
+* **deadline-at-pull** — a request that expired while queued resolves
+  ``"deadline"`` and never reaches the device;
+* **SIGTERM drain** — in-flight work finishes, everything still queued
+  sheds ``"drain"``, and the counters still sum;
+* **``serve.batch`` chaos** — retry exhaustion dead-letters with a
+  reason instead of hanging clients, and the service recovers once the
+  fault clears;
+* **continuous parity** — 200 concurrent mixed-length requests through
+  a CONTINUOUS service match the bucketed path ≤1e-6 with
+  ``score_trace_count`` flat (one warm program);
+* **the headline** — on the seeded closed-loop load harness with a slow
+  fake device, the continuous dispatcher's p50 ``serve.queue_wait_s``
+  is ≥3× below ragged's: admission decoupled from device latency.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from memvul_tpu import telemetry
+from memvul_tpu.data.readers import MemoryReader
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.models import BertConfig, MemoryModel
+from memvul_tpu.resilience import faults
+from memvul_tpu.resilience.retry import RetryPolicy
+from memvul_tpu.serving import (
+    STATUS_DEADLINE,
+    STATUS_DRAIN,
+    STATUS_OK,
+    STATUS_SHED,
+    InprocessClient,
+    ScoringService,
+    ServiceConfig,
+)
+from memvul_tpu.serving.loadgen import LoadConfig, LoadGenerator
+
+IMPLS = ["bucketed", "ragged", "continuous"]
+
+# response status → the telemetry sub-counter that must match it exactly
+STATUS_TO_COUNTER = {
+    STATUS_OK: "serve.served",
+    STATUS_SHED: "serve.shed_overflow",
+    STATUS_DEADLINE: "serve.shed_deadline",
+    STATUS_DRAIN: "serve.shed_drain",
+    "error": "serve.errors",
+}
+
+
+@pytest.fixture()
+def tel(tmp_path):
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    yield registry
+    telemetry.reset()
+    faults.reset()
+
+
+class _FakeEncoder:
+    pad_id = 0
+
+    def __init__(self, max_length=8):
+        self.max_length = max_length
+
+    def encode_many(self, texts):
+        return [[1] * max(1, min(len(t), self.max_length)) for t in texts]
+
+
+class _StrategyFake:
+    """Minimal predictor surface valid for every dispatch strategy;
+    scoring blocks until released (``hold``) and optionally sleeps a
+    fixed per-batch device time, so the tests control exactly when and
+    for how long the device is busy."""
+
+    def __init__(
+        self, impl, n_anchors=3, rows=4, length=8, budget=32, device_s=0.0
+    ):
+        self.score_impl = impl
+        self.encoder = _FakeEncoder(length)
+        self.mesh = None
+        self.params = None
+        self.n_anchors = n_anchors
+        self.anchor_labels = [f"A{i}" for i in range(n_anchors)]
+        self.anchor_bank = np.zeros((n_anchors, 2), np.float32)
+        self.score_trace_count = 0
+        self._shapes = [(rows, length)]
+        self._rows = rows
+        self._budget = budget
+        self.device_s = device_s
+        self.started = threading.Event()  # set when a batch enters scoring
+        self.hold = threading.Event()     # scoring blocks until set
+
+    def stream_shapes(self):
+        return list(self._shapes)
+
+    def ragged_shape(self):
+        return (self._budget, self._rows)
+
+    def _score(self, rows):
+        self.started.set()
+        assert self.hold.wait(timeout=30), "test forgot to release hold"
+        if self.device_s:
+            time.sleep(self.device_s)
+        return np.tile(
+            np.linspace(0.1, 0.9, self.n_anchors, dtype=np.float32), (rows, 1)
+        )
+
+    def _score_fn(self, params, sample, bank):
+        return self._score(sample["input_ids"].shape[0])
+
+    def _ragged_score_fn(self, params, sample, bank):
+        return self._score(self._rows)
+
+
+def _make_service(impl, fake=None, **overrides):
+    fake = fake or _StrategyFake(impl)
+    defaults = dict(
+        max_batch=4, max_wait_ms=1.0, max_queue=1000,
+        default_deadline_ms=0.0,
+    )
+    defaults.update(overrides)
+    return fake, ScoringService(fake, config=ServiceConfig(**defaults))
+
+
+def _statuses(futures, timeout=30):
+    counts = {}
+    for future in futures:
+        status = future.result(timeout=timeout)["status"]
+        counts[status] = counts.get(status, 0) + 1
+    return counts
+
+
+def _assert_counters_agree(statuses, counters):
+    """The exact-counter contract every strategy inherits: each
+    per-status response count equals its sub-counter, the shed ledger
+    sums, and nothing is lost or double-counted."""
+    for status, counter in STATUS_TO_COUNTER.items():
+        assert counters.get(counter, 0) == statuses.get(status, 0), (
+            status, counters,
+        )
+    assert counters.get("serve.shed", 0) == (
+        counters.get("serve.shed_overflow", 0)
+        + counters.get("serve.shed_deadline", 0)
+        + counters.get("serve.shed_drain", 0)
+    )
+    assert (
+        counters.get("serve.served", 0)
+        + counters.get("serve.shed", 0)
+        + counters.get("serve.errors", 0)
+    ) == counters["serve.requests"]
+
+
+# -- the shared harness, all three strategies ---------------------------------
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_counter_invariant_overflow_and_deadline_at_pull(impl, tel):
+    """Blocked device + saturated pipeline + a deadline burst: overflow
+    sheds, queued requests expire AT THE PULL (they never reach the
+    device), and every counter matches the response counts exactly."""
+    fake, service = _make_service(impl, max_queue=4, max_wait_ms=1.0)
+    # occupy the device: the first request blocks in scoring...
+    preload = [service.submit(f"warm {i}", deadline_ms=0) for i in range(1)]
+    assert fake.started.wait(timeout=10)
+    # ...then fill the strategy's internal pipeline in paced waves (for
+    # continuous: one pack on device, one sealed in the handoff, one
+    # sealing; for the pull strategies the queue itself) so admission is
+    # genuinely stalled before the burst lands
+    for wave in range(2):
+        preload += [
+            service.submit(f"fill {wave}-{i}", deadline_ms=0)
+            for i in range(4)
+        ]
+        time.sleep(0.05)  # let the admission side absorb the wave
+    # burst past the queue cap with a short deadline: the overflow sheds
+    # the oldest immediately, the survivors expire while queued
+    burst = [service.submit(f"late {i}", deadline_ms=50.0) for i in range(8)]
+    time.sleep(0.1)  # all burst deadlines are now past
+    fake.hold.set()
+    statuses = _statuses(preload + burst)
+    service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.requests"] == 17
+    _assert_counters_agree(statuses, counters)
+    # the load exercised every admission outcome
+    assert statuses.get(STATUS_OK, 0) >= 1
+    assert statuses.get(STATUS_SHED, 0) >= 1       # overflow landed
+    assert statuses.get(STATUS_DEADLINE, 0) >= 1   # expiry at the pull landed
+    assert statuses.get("error", 0) == 0
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_sigterm_drain_finishes_inflight_sheds_queue(impl, tel):
+    """SIGTERM mid-load: pulled work finishes ``"ok"``, everything still
+    queued sheds ``"drain"``, and the counters still sum exactly."""
+    fake, service = _make_service(impl)
+    previous = service.install_signal_handlers()
+    try:
+        futures = [service.submit(f"req {i}", deadline_ms=0) for i in range(40)]
+        assert fake.started.wait(timeout=10)
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        fake.hold.set()
+        service.drain()
+    finally:
+        service.restore_signal_handlers(previous)
+    statuses = _statuses(futures)
+    counters = tel.snapshot()["counters"]
+    assert set(statuses) <= {STATUS_OK, STATUS_DRAIN}
+    assert statuses.get(STATUS_OK, 0) >= 1     # in-flight work finished
+    assert statuses.get(STATUS_DRAIN, 0) >= 1  # the kill landed mid-load
+    assert counters["serve.requests"] == 40
+    _assert_counters_agree(statuses, counters)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("impl", IMPLS)
+def test_serve_batch_fault_dead_letters_then_recovers(impl, tel):
+    """Retry exhaustion on the ``serve.batch`` fault point dead-letters
+    with the reason — through whichever thread the strategy scores on —
+    and the service recovers once the fault set is spent."""
+    faults.configure(
+        "serve.batch=raise:RuntimeError:UNAVAILABLE a;"
+        "serve.batch=raise:RuntimeError:UNAVAILABLE b;"
+        "serve.batch=raise:RuntimeError:UNAVAILABLE c"
+    )
+    fake = _StrategyFake(impl)
+    fake.hold.set()
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=4, max_wait_ms=1.0, default_deadline_ms=0.0,
+        ),
+        retry_policy=RetryPolicy(attempts=3, sleep=lambda s: None),
+    )
+    client = InprocessClient(service)
+    response = client.score("doomed", timeout_s=30)  # must not hang
+    assert response["status"] == "error"
+    assert "UNAVAILABLE" in response["reason"]
+    # the fault set is spent — the service recovers without a restart
+    faults.reset()
+    assert client.score("fine")["status"] == STATUS_OK
+    service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.dead_letters"] == 1
+    assert counters["serve.errors"] == 1
+    _assert_counters_agree({STATUS_OK: 1, "error": 1}, counters)
+
+
+@pytest.mark.parametrize("impl", IMPLS)
+def test_health_summary_names_the_strategy(impl, tel):
+    fake, service = _make_service(impl)
+    fake.hold.set()
+    try:
+        summary = service.health_summary()
+        assert summary["score_impl"] == impl
+        assert summary["status"] == "ok"
+        # liveness ANDs the strategy's own workers into the signal (for
+        # continuous: the device worker thread)
+        assert service.batcher_alive
+    finally:
+        service.drain()
+
+
+def test_unknown_score_impl_rejected(tel):
+    fake = _StrategyFake("bucketed")
+    fake.score_impl = "warp"
+    with pytest.raises(ValueError, match="unknown score_impl"):
+        ScoringService(fake, config=ServiceConfig(max_wait_ms=1.0))
+
+
+# -- continuous parity against the offline path --------------------------------
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("dispatch"), seed=13)
+
+
+@pytest.fixture(scope="module")
+def setup(ws):
+    """One tiny model + a bucketed and a CONTINUOUS predictor sharing
+    its params — the parity pair (jit caches persist across tests, the
+    warmed-program reuse the service relies on)."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    anchors = list(reader.read_anchors(ws["paths"]["anchors"]))
+    bucketed = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=8, max_length=48, buckets=[16, 48],
+    )
+    bucketed.encode_anchors(anchors)
+    continuous = SiamesePredictor(
+        model, params, ws["tokenizer"],
+        batch_size=8, max_length=48,
+        score_impl="continuous", token_budget=96, max_rows_per_pack=8,
+    )
+    continuous.encode_anchors(anchors)
+    texts = [
+        inst["text1"]
+        for inst in reader.read(ws["paths"]["test"], split="test")
+    ]
+    return {"bucketed": bucketed, "continuous": continuous, "texts": texts}
+
+
+def test_continuous_service_concurrent_load_parity_one_warm_program(
+    setup, tel
+):
+    """200 concurrent mixed-length requests through a CONTINUOUS
+    service: every response matches the bucketed offline path ≤1e-6,
+    zero mid-serve recompiles (the continuous dispatcher shares the
+    ragged warm program), and the overlap counters registered load."""
+    bucketed, continuous = setup["bucketed"], setup["continuous"]
+    n = 200
+    picks = [setup["texts"][i % len(setup["texts"])] for i in range(n)]
+    expected = bucketed.score_texts(picks)
+    traces_before = continuous.score_trace_count
+
+    service = ScoringService(
+        continuous,
+        config=ServiceConfig(
+            max_batch=8, max_wait_ms=3.0, max_queue=1000,
+            default_deadline_ms=30000.0,
+        ),
+    )
+    client = InprocessClient(service)
+    results = {}
+    lock = threading.Lock()
+
+    def worker(indices):
+        for i in indices:
+            response = client.score(picks[i])
+            with lock:
+                results[i] = response
+
+    threads = [
+        threading.Thread(target=worker, args=(range(k, n, 16),))
+        for k in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    service.drain()
+
+    assert len(results) == n
+    labels = continuous.anchor_labels
+    for i in range(n):
+        assert results[i]["status"] == STATUS_OK
+        got = np.array(
+            [results[i]["predict"][label] for label in labels], np.float32
+        )
+        np.testing.assert_allclose(got, expected[i], atol=1e-6, rtol=0)
+    # one warm program served the whole mixed-length load
+    assert continuous.score_trace_count == traces_before
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.served"] == n
+    assert counters["serve.requests"] == n
+    # padding ledger: every sealed pack paid exactly token_budget slots
+    assert counters["serve.tokens_padded"] % continuous.token_budget == 0
+    assert 0 < counters["serve.tokens_real"] <= counters["serve.tokens_padded"]
+    # the page table recycled slots across packs under sustained load
+    assert counters.get("serve.pack_slots_reused", 0) > 0
+
+
+def test_report_renders_admission_efficiency(tmp_path):
+    """telemetry-report derives serve.admission_efficiency from the
+    overlap ledger (pack_topups / served) in both the text COUNTERS
+    section and the --json report."""
+    from memvul_tpu.telemetry.report import render_report, report_json
+
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    registry.counter("serve.pack_topups").inc(30)
+    registry.counter("serve.served").inc(40)
+    registry.counter("serve.pack_slots_reused").inc(12)
+    registry.close()
+    try:
+        text = render_report(tmp_path / "run")
+        report = report_json(tmp_path / "run")
+    finally:
+        telemetry.reset()
+    assert "serve.admission_efficiency = 0.750" in text
+    assert "(30/40 served admitted mid-flight)" in text
+    assert "serve.pack_slots_reused = 12" in text
+    assert report["derived"]["serve.admission_efficiency"] == 0.75
+
+
+# -- the headline: admission decoupled from device latency ---------------------
+
+def _queue_wait_leg(impl, texts):
+    """One seeded closed-loop leg against a slow fake device; returns
+    (p50 queue wait seconds, leg counters)."""
+    registry = telemetry.configure(run_dir=None)
+    try:
+        fake = _StrategyFake(impl, rows=8, length=8, budget=64, device_s=0.05)
+        fake.hold.set()
+        service = ScoringService(
+            fake,
+            config=ServiceConfig(
+                max_batch=8, max_wait_ms=2.0, max_queue=1000,
+                default_deadline_ms=0.0, trace_sample_rate=1.0,
+            ),
+        )
+        report = LoadGenerator(
+            service.submit,
+            LoadConfig(pattern="closed", requests=64, clients=16, seed=5),
+        ).run(texts)
+        service.drain()
+        assert report["outcomes"]["hang"] == 0
+        assert report["outcomes"]["ok"] == 64
+        snap = registry.snapshot()
+        hist = snap["histograms"]["serve.queue_wait_s"]
+        assert hist["count"] == 64
+        return hist["p50"], snap["counters"]
+    finally:
+        telemetry.reset()
+
+
+def test_continuous_queue_wait_p50_at_least_3x_below_ragged():
+    """The acceptance bar: at offered load beyond device throughput
+    (16 closed-loop clients vs an 8-row 50 ms device), the ragged
+    pull-then-seal loop makes every request wait out device round-trips
+    before it is even coalesced, while continuous admission pops it into
+    the in-flight pack almost immediately — p50 ``serve.queue_wait_s``
+    drops ≥3× on the identical seeded schedule."""
+    texts = [f"req {'x' * (i % 11)}" for i in range(16)]
+    ragged_p50, _ = _queue_wait_leg("ragged", texts)
+    continuous_p50, counters = _queue_wait_leg("continuous", texts)
+    # the slow device is the bottleneck in BOTH legs; only admission
+    # latency differs — that is the entire point of the strategy
+    assert ragged_p50 >= 3.0 * continuous_p50, (ragged_p50, continuous_p50)
+    # and the overlap the gain comes from is visible in the counters
+    assert counters.get("serve.pack_topups", 0) > 0
+    assert counters.get("serve.pack_slots_reused", 0) > 0

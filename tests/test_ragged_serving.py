@@ -591,10 +591,10 @@ def test_serve_from_archive_ragged_end_to_end(ws, tmp_path, tel):
 
 def test_serve_microbench_ab_emits_token_ledger(monkeypatch, capsys):
     """BENCH_MICRO=serve BENCH_SERVE_IMPL=ab at tiny geometry: one
-    parseable record with both legs' real/padded token counts, ragged
-    real_token_utilization above bucketed on the same seeded skewed
-    schedule — the CPU-runnable shape of the owed on-hardware
-    datapoint."""
+    parseable record with all three legs' real/padded token counts,
+    ragged real_token_utilization above bucketed on the same seeded
+    skewed schedule, and the continuous leg's queue-wait ledger — the
+    CPU-runnable shape of the owed on-hardware datapoint."""
     from memvul_tpu import bench
 
     monkeypatch.setenv("BENCH_MICRO", "serve")
@@ -612,15 +612,23 @@ def test_serve_microbench_ab_emits_token_ledger(monkeypatch, capsys):
     assert record["metric"] == "serve_microbench"
     assert record["config"]["impl_mode"] == "ab"
     legs = record["ab"]
-    assert set(legs) == {"bucketed", "ragged"}
+    assert set(legs) == {"bucketed", "ragged", "continuous"}
     for leg in legs.values():
         assert leg["errors"] == 0
         assert leg["real_tokens"] > 0
         assert leg["padded_tokens"] >= leg["real_tokens"]
         assert 0 < leg["real_token_utilization"] <= 1
+        # ab mode turns tracing on so the admission-wait comparison has
+        # data in every leg
+        assert leg["queue_wait_ms"] is not None
+        assert leg["queue_wait_ms"]["p50"] >= 0
     assert (
         legs["ragged"]["real_token_utilization"]
         > legs["bucketed"]["real_token_utilization"]
     )
-    assert record["impl"] == "ragged"
+    # the continuous leg's headline: p50 admission wait vs ragged on the
+    # identical schedule (the ≥3× acceptance bar needs high offered load
+    # and a slow device — at this tiny geometry only presence is pinned)
+    assert record["queue_wait_gain"] > 0
+    assert record["impl"] == "continuous"
     assert record["value"] > 0

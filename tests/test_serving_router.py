@@ -651,7 +651,14 @@ def test_subprocess_replica_sigkill_mid_load_invariant_and_no_hang(tmp_path):
     proc = subprocess.run(
         [sys.executable, str(driver)],
         capture_output=True, text=True, timeout=240,
-        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        # the fresh interpreter inherits no pytest sys.path surgery, so
+        # hand it the parent's import path explicitly — without it the
+        # driver can't import memvul_tpu from a source checkout
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": __import__("os").pathsep.join(sys.path),
+        },
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     record = json.loads(proc.stdout.strip().splitlines()[-1])

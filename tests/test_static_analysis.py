@@ -729,3 +729,44 @@ def test_observability_endpoints_snapshot_only_known_bad(tmp_path):
         ("pkg/bad_endpoints.py", 7, "pack_token_budget"),
         ("pkg/bad_endpoints.py", 9, "rolling_swap"),
     ], hits
+
+
+def test_dispatcher_admission_path_known_bad(tmp_path):
+    """The ``*Dispatcher`` admission discipline (serving/dispatch.py): a
+    future dispatcher that sleeps, round-trips the device through the
+    synchronous ``score_texts`` convenience, or calls a ``predict*``
+    offline entry point fails MV102 — while the serving-surface calls a
+    dispatcher exists to make (encode/pack/collate and the jitted score
+    fns) stay legal, both in a ``Dispatcher``-derived subclass and in a
+    name-matched base."""
+    _write_tree(tmp_path, {
+        "pkg/bad_dispatch.py": (
+            "import time\n"
+            "class Dispatcher:\n"
+            "    def run(self):\n"
+            "        time.sleep(0.1)\n"
+            "class EagerDispatcher(Dispatcher):\n"
+            "    def _admit(self, request):\n"
+            "        self.predictor.score_texts([request.text])\n"
+            "    def _flush(self):\n"
+            "        self.predictor.predict_file('corpus')\n"
+        ),
+        "pkg/good_dispatch.py": (
+            "class ContinuousDispatcher:\n"
+            "    def _admit(self, request):\n"
+            "        seq = self.encoder.encode_many([request.text])[0]\n"
+            "        pack_token_budget([len(seq)], 96, 4)\n"
+            "        sample = collate_ragged([seq], 96, 4, 0)\n"
+            "        return self.predictor._ragged_score_fn(\n"
+            "            self.params, sample, self.bank)\n"
+        ),
+    })
+    result = _analyze_fixture(tmp_path, select=["MV102"])
+    hits = sorted(
+        (f.path, f.line, f.symbol) for f in result.active
+    )
+    assert hits == [
+        ("pkg/bad_dispatch.py", 4, "sleep"),
+        ("pkg/bad_dispatch.py", 7, "score_texts"),
+        ("pkg/bad_dispatch.py", 9, "predict_file"),
+    ], hits

@@ -1,16 +1,21 @@
 #!/usr/bin/env python
-"""Lint: HTTP handler threads may only enqueue + wait on a future, and
-router dispatch classes may only select a replica queue.
+"""Lint: HTTP handler threads may only enqueue + wait on a future,
+router dispatch classes may only select a replica queue, and
+``*Dispatcher`` admission paths may never sleep or round-trip the
+device per request.
 
 Thin shim over the shared static-analysis engine
 (``memvul_tpu/analysis/``, checker **MV102** — docs/static_analysis.md):
-the engine owns the single AST walk and the forbidden-name set (the
-serving tier's scoring/encoding/packing surface plus ``sleep``; see
+the engine owns the single AST walk and the per-family forbidden-name
+sets (the serving tier's scoring/encoding/packing surface plus
+``sleep`` for handlers/routers; the narrow stall-shaped set —
+``sleep``/``score_texts``/``predict*`` — for dispatcher classes; see
 ``memvul_tpu/analysis/checkers/handlers.py``); this entry point only
 preserves the historical CLI contract and the ``find_blocking_calls``
 helper the tier-1 tests import.  Rationale lives in docs/serving.md: a
 handler that scores inline serializes the server behind one connection;
-a router that does it stalls every request in the process.
+a router that does it stalls every request in the process; a dispatcher
+that blocks its admission loop re-couples queue wait to device latency.
 
 Usage: ``python tools/lint_no_blocking_in_handler.py [package_dir]`` —
 exits 1 listing offenders as 1-based ``path:line: name``, 0 when clean,
@@ -31,8 +36,9 @@ if str(_REPO) not in sys.path:
 
 def find_blocking_calls(package_dir: Path) -> List[str]:
     """``path:line: name`` for every forbidden call inside a
-    ``*RequestHandler`` subclass or a ``*Router`` dispatch class under
-    ``package_dir``, via the shared engine's MV102 checker."""
+    ``*RequestHandler`` subclass, a ``*Router`` dispatch class, or a
+    ``*Dispatcher`` strategy class under ``package_dir``, via the
+    shared engine's MV102 checker."""
     from memvul_tpu.analysis import run_tool_checkers
 
     package_dir = Path(package_dir)
