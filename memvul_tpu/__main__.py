@@ -6,6 +6,7 @@ scripts (reference: README.md:130-147).  Here everything is one CLI:
 
     python -m memvul_tpu train configs/config_memory.json -s out/
     python -m memvul_tpu evaluate out/model.tar.gz data/test_project.json -o eval/
+    python -m memvul_tpu score-corpus out/model.tar.gz data/test_project.json -o eval/ --shards 4
     python -m memvul_tpu serve out/ -o serve_run/
     python -m memvul_tpu pretrain configs/further_pretrain.json
     python -m memvul_tpu baseline data/train_project.json data/test_project.json -o baseline_out/
@@ -105,6 +106,44 @@ def cmd_evaluate(args) -> int:
             thres=args.threshold,
         )
     print(json.dumps(metrics, default=float))
+    return 0
+
+
+def cmd_score_corpus(args) -> int:
+    """Sharded map-reduce corpus scoring (docs/full_corpus.md): N
+    supervised worker subprocesses, exactly-once merge verification,
+    metrics byte-identical to a single-process evaluate.  Exit codes:
+    0 success, 1 merge-verification/run failure, 2 usage, 3 partial
+    completion (quarantined shards; the machine-readable refusal is
+    printed as JSON on stdout)."""
+    from .distributed import (
+        MergeVerificationError,
+        PartialCompletionError,
+        score_corpus,
+    )
+
+    try:
+        result = score_corpus(
+            args.archive,
+            args.test_path,
+            args.out_dir,
+            shards=args.shards,
+            overrides=args.overrides,
+            golden_file=args.golden_file,
+            name=args.name,
+            thres=args.threshold,
+            split=args.split,
+        )
+    except PartialCompletionError as e:
+        print(json.dumps(e.payload, default=str))
+        return 3
+    except MergeVerificationError as e:
+        print(json.dumps(e.payload, default=str), file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"score-corpus: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(result, default=float))
     return 0
 
 
@@ -673,6 +712,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the whole eval "
                    "(same scope bench.py's BENCH_PROFILE uses)")
     p.set_defaults(fn=cmd_evaluate)
+
+    p = sub.add_parser(
+        "score-corpus",
+        help="score a corpus across N supervised worker subprocesses "
+        "(sharded map-reduce with journal resume per shard, heartbeat "
+        "supervision + backoff restarts, and exactly-once merge "
+        "verification — docs/full_corpus.md); exit 3 = partial "
+        "completion with the missing spans named",
+    )
+    p.add_argument("archive", help="model.tar.gz or its serialization dir")
+    p.add_argument("test_path")
+    p.add_argument("-o", "--out-dir", required=True)
+    p.add_argument("--shards", type=int, default=None,
+                   help="worker subprocesses (default: the archive's "
+                   "evaluation.shards, 1)")
+    p.add_argument("--overrides", default=None)
+    p.add_argument("--golden-file", default=None,
+                   help="anchor file (defaults to the config's)")
+    p.add_argument("--name", default=None, help="output file prefix")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--split", default=None,
+                   help="corpus split passed to the reader")
+    p.set_defaults(fn=cmd_score_corpus)
 
     p = sub.add_parser("pretrain", help="MLM further-pretraining")
     p.add_argument("config")

@@ -57,7 +57,7 @@ from ..engine import (
 # injection points are dotted — single-token names ("a=raise") are the
 # fault-parser unit tests' fixtures, not registry members
 _FAULT_SPEC_RE = re.compile(
-    r"([A-Za-z_][\w-]*(?:\.[\w.-]+)+)(?:@\d+)?=(?:raise|sigterm|sigint)\b"
+    r"([A-Za-z_][\w-]*(?:\.[\w.-]+)+)(?:@\d+)?=(?:raise|sigterm|sigint|sigkill)\b"
 )
 _FAULT_CALL_RE = re.compile(r"""fault_point\(\s*["']([^"']+)["']\s*\)""")
 

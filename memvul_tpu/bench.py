@@ -46,7 +46,10 @@ BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher;
 train_step — A/B the Siamese train step's collation, pad-to-max vs
 bucketed+anchor-dedup over one identical pair stream, reporting padded-
 vs real-token throughput for both paths,
-BENCH_TRAIN_{STEPS,BATCH,ACCUM} set the load — docs/training_throughput.md),
+BENCH_TRAIN_{STEPS,BATCH,ACCUM} set the load — docs/training_throughput.md;
+corpus — sharded full-corpus scoring through the supervised worker fleet,
+BENCH_CORPUS_SHARDS/BENCH_CORPUS_REPORTS set the shape —
+docs/full_corpus.md),
 BENCH_PHASE_TIMEOUT (per-phase watchdog deadline inside the child,
 default 600 s, 0 disables — a stuck phase emits a parseable JSON
 failure record naming the phase, its last-heartbeat age (stuck phase vs
@@ -244,10 +247,13 @@ def _run_bench() -> None:
     if os.environ.get("BENCH_MICRO") == "train_step":
         _run_train_step_micro()
         return
+    if os.environ.get("BENCH_MICRO") == "corpus":
+        _run_corpus_micro()
+        return
     if os.environ.get("BENCH_MICRO"):
         raise ValueError(
             f"unknown BENCH_MICRO mode {os.environ['BENCH_MICRO']!r} "
-            "(known: anchor_match, serve, train_step)"
+            "(known: anchor_match, corpus, serve, train_step)"
         )
     import numpy as np
     import jax
@@ -1053,6 +1059,117 @@ def _run_serve_router_micro(
                     "max_batch": max_batch,
                     "max_wait_ms": max_wait_ms,
                 },
+            }
+        )
+    )
+
+
+def _run_corpus_micro() -> None:
+    """BENCH_MICRO=corpus: sharded full-corpus scoring throughput
+    (docs/full_corpus.md).
+
+    Builds a tiny untrained archive over a synthetic workspace, runs
+    ``score_corpus`` across BENCH_CORPUS_SHARDS supervised worker
+    subprocesses, and reports total rows/s plus the per-shard rates the
+    coordinator's merge verified exactly-once.  No training happens —
+    the number measures the distribution machinery (spawn, heartbeat
+    supervision, journal replay, merge verification), not the model.
+
+    Knobs: BENCH_CORPUS_SHARDS (worker count, default 2),
+    BENCH_CORPUS_REPORTS (workspace reports per project, default 64),
+    BENCH_SEQ_LEN (max_length cap, default 64).
+    """
+    from pathlib import Path
+
+    from memvul_tpu.utils.platform import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    from memvul_tpu.archive import save_archive
+    from memvul_tpu.build import build_model, init_params
+    from memvul_tpu.data.synthetic import build_workspace
+    from memvul_tpu.distributed import score_corpus
+    from memvul_tpu.telemetry.sinks import HeartbeatFile
+
+    watchdog = _watchdog()
+    n_shards = int(os.environ.get("BENCH_CORPUS_SHARDS", "2"))
+    per_project = int(os.environ.get("BENCH_CORPUS_REPORTS", "64"))
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "64"))
+
+    with watchdog.phase("workspace"):
+        ws = build_workspace(
+            tempfile.mkdtemp(), seed=0, num_projects=8,
+            reports_per_project=per_project, realistic_lengths=True,
+        )
+    root = Path(tempfile.mkdtemp())
+    model_cfg = {
+        "type": "model_memory",
+        "encoder": {"preset": "tiny", "vocab_size": ws["tokenizer"].vocab_size},
+        "header_dim": 32,
+    }
+    config = {
+        "tokenizer": {
+            "type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"],
+        },
+        "dataset_reader": {
+            "type": "reader_memory",
+            "anchor_path": ws["paths"]["anchors"],
+            "cve_path": ws["paths"]["cve"],
+        },
+        "model": model_cfg,
+        "evaluation": {"batch_size": 8, "max_length": seq_len},
+        "telemetry": {"heartbeat_every_s": 1.0},
+    }
+    with watchdog.phase("archive"):
+        model = build_model(dict(model_cfg), ws["tokenizer"].vocab_size)
+        params = init_params(model, seed=0)
+        archive = save_archive(
+            root / "model.tar.gz", config, params,
+            tokenizer_file=ws["paths"]["tokenizer"],
+        )
+
+    out_dir = root / "corpus_run"
+    with watchdog.phase("score_corpus"):
+        t0 = time.perf_counter()
+        result = score_corpus(
+            archive, ws["paths"]["test"], out_dir, shards=n_shards,
+        )
+        wall = time.perf_counter() - t0
+
+    per_shard = []
+    for summary in result["shards"]:
+        hb = HeartbeatFile(
+            out_dir / summary["shard"] / "HEARTBEAT.json"
+        ).read()
+        uptime = float(hb.get("uptime_s") or 0.0)
+        rows = summary["rows"]
+        per_shard.append({
+            "shard": summary["shard"],
+            "rows": rows,
+            "restarts": summary["restarts"],
+            "rows_per_s": round(rows / uptime, 2) if uptime > 0 else 0.0,
+        })
+
+    print(
+        json.dumps(
+            {
+                "metric": "corpus_microbench",
+                "value": round(result["corpus_rows"] / max(wall, 1e-9), 2),
+                "unit": "rows/s",
+                "vs_baseline": 0.0,  # no corpus-scoring baseline (BASELINE.md)
+                "corpus_rows": result["corpus_rows"],
+                "wall_s": round(wall, 3),
+                "merge_wall_s": round(result["merge_wall_s"], 3),
+                "restarts": result["restarts"],
+                "per_shard": per_shard,
+                "verification": result["verification"],
+                "config": {
+                    "shards": n_shards,
+                    "seq_len": seq_len,
+                    "reports_per_project": per_project,
+                },
+                **_program_blocks(),
             }
         )
     )

@@ -599,6 +599,39 @@ def serve_from_archive(
     )))
 
 
+def score_corpus_from_archive(
+    archive_path: Union[str, Path],
+    test_path: Union[str, Path],
+    out_dir: Union[str, Path],
+    shards: Optional[int] = None,
+    overrides: Optional[Union[str, Dict[str, Any]]] = None,
+    golden_file: Optional[Union[str, Path]] = None,
+    name: Optional[str] = None,
+    thres: float = 0.5,
+    split: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Sharded map-reduce corpus scoring: ``evaluate_from_archive``'s
+    artifact contract (``{name}_result.json`` + ``{name}_metric_all.json``
+    in ``out_dir``), produced by N supervised worker subprocesses with
+    exactly-once merge verification (``distributed/``,
+    docs/full_corpus.md).  Shard knobs ride ``config.EVALUATION_DEFAULTS``
+    (``shards``, ``max_shard_attempts``, ``shard_stall_timeout_s``, …);
+    the ``shards`` argument overrides the config."""
+    from .distributed import score_corpus
+
+    return score_corpus(
+        archive_path,
+        test_path,
+        out_dir,
+        shards=shards,
+        overrides=overrides,
+        golden_file=golden_file,
+        name=name,
+        thres=thres,
+        split=split,
+    )
+
+
 def _auto_buckets_for_corpus(
     reader, tokenizer, test_path, max_length: int, n_buckets: int = 8,
     sample: int = 2048,

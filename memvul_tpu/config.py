@@ -243,6 +243,14 @@ EVALUATION_DEFAULTS: Dict[str, Any] = {
     # (docs/anchor_bank.md) — off so the default output format stays
     # byte-stable with the reference's
     "attribute_anchors": False,
+    # sharded corpus scoring (distributed/, docs/full_corpus.md) — the
+    # score-corpus CLI reads these; shards=1 keeps the single-worker
+    # degenerate case the default
+    "shards": 1,               # supervised worker subprocesses
+    "max_shard_attempts": 3,   # launches per shard before quarantine
+    "shard_stall_timeout_s": 120.0,  # heartbeat age that counts as wedged
+    "shard_poll_interval_s": 1.0,    # supervisor poll cadence
+    "shard_backoff_s": 2.0,    # restart backoff base (exponential)
 }
 
 

@@ -1,0 +1,29 @@
+"""Sharded map-reduce corpus scoring (docs/full_corpus.md).
+
+The paper's corpus is 1.22M issue reports and ``predict_file`` is a
+single-process stream — one wedged host serializes the whole multi-hour
+pass.  This package composes the existing resilience ingredients
+(``ScoreJournal`` resume, ``DeadLetter`` quarantine, ``RetryPolicy``
+backoff, per-run telemetry + live ``/metrics``) into a supervised
+multi-process run:
+
+* :func:`partition.partition_rows` — deterministic contiguous row-span
+  partition of the corpus (pure in (corpus length, shard count), so a
+  restarted coordinator recomputes identical spans);
+* ``worker`` — one subprocess per shard, running the resumable
+  ``predict_file`` over its span with its own journal, dead-letter
+  file, and ``HEARTBEAT.json``;
+* :func:`coordinator.score_corpus` — launches and supervises the
+  workers (heartbeat-age stall detection, exit-code death detection,
+  exponential-backoff restarts, quarantine after ``max_shard_attempts``),
+  then merges shard outputs in partition order under an exactly-once
+  verification pass before computing corpus metrics byte-identical to a
+  single-process run.
+"""
+
+from .coordinator import (  # noqa: F401
+    MergeVerificationError,
+    PartialCompletionError,
+    score_corpus,
+)
+from .partition import partition_rows  # noqa: F401

@@ -1,0 +1,38 @@
+"""Deterministic corpus partitioning for sharded scoring.
+
+The partition MUST be a pure, stable function of ``(corpus length,
+shard count)``: the coordinator recomputes it on every (re)start, the
+merge verifier recomputes it to prove exactly-once coverage, and a
+resumed worker's journal only makes sense if its span is the same one
+it was launched with.  Any randomness or environment dependence here
+would make the exactly-once guarantee vacuous — pinned by
+``tests/test_distributed.py::test_partition_rows_pure_and_stable``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def partition_rows(corpus_len: int, n_shards: int) -> List[Tuple[int, int]]:
+    """Split ``range(corpus_len)`` into ``n_shards`` contiguous
+    ``[start, end)`` spans.
+
+    Spans are maximally even: the first ``corpus_len % n_shards`` shards
+    carry one extra row.  Shards beyond the corpus length get empty
+    spans (``start == end``) rather than being dropped, so shard *i*
+    always exists and always owns the same rows for a given
+    ``(corpus_len, n_shards)``.
+    """
+    if corpus_len < 0:
+        raise ValueError(f"corpus_len must be >= 0, got {corpus_len}")
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    base, extra = divmod(corpus_len, n_shards)
+    spans: List[Tuple[int, int]] = []
+    start = 0
+    for i in range(n_shards):
+        end = start + base + (1 if i < extra else 0)
+        spans.append((start, end))
+        start = end
+    return spans

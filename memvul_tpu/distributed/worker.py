@@ -1,0 +1,178 @@
+"""One shard worker: score a contiguous row span of the corpus.
+
+Launched by the coordinator as ``python -m memvul_tpu.distributed.worker
+<spec.json>`` — one subprocess per shard, in its own session (killable
+as a process group).  The spec carries everything pre-resolved by the
+coordinator (archive path, span, the merged evaluation config, explicit
+bucket boundaries) so every attempt of every shard scores under one
+identical configuration.
+
+The worker is just the existing resumable single-process machinery
+pointed at a slice: ``predict_file(resume=True)`` with the shard's own
+journal (``<out>.journal``), dead-letter file, and ``HEARTBEAT.json``.
+A SIGKILLed attempt replays nothing it committed — the next attempt's
+journal resume skips the verified prefix, which is what makes restarts
+free of double-scoring (the merge verifier proves it).
+
+Completion contract: exit 0 **and** an atomically-written
+``shard_metrics.json`` marker.  Exit 0 without the marker is treated as
+a failure by the supervisor (a worker that died between the last
+journal append and the marker write).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Dict, Iterator, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class SpanReader:
+    """Wrap a dataset reader to yield only rows ``[start, end)`` of the
+    (post-quarantine) stream, salted with the ``shard.kill`` /
+    ``shard.stall`` fault points.
+
+    ``shard.kill`` (or ``shard.kill.<shard>``) fires before a row is
+    yielded — with the ``sigkill`` action it dies exactly like an
+    OOM-killed host, no handler, no cleanup.  ``shard.stall`` armed with
+    a ``raise`` action wedges the worker instead: it stops yielding and
+    sleeps forever, so heartbeat age grows and the supervisor's stall
+    detector (not an exit code) must catch it.
+    """
+
+    def __init__(self, reader, start: int, end: int, shard: str) -> None:
+        self._reader = reader
+        self.start = int(start)
+        self.end = int(end)
+        self.shard = shard
+
+    def read(
+        self,
+        file_path: str,
+        split: Optional[str] = None,
+        quarantine=None,
+    ) -> Iterator[Dict]:
+        from ..resilience import faults
+
+        stream = (
+            self._reader.read(file_path, split=split, quarantine=quarantine)
+            if quarantine is not None
+            else self._reader.read(file_path, split=split)
+        )
+        for inst in itertools.islice(stream, self.start, self.end):
+            faults.fault_point("shard.kill")
+            faults.fault_point(f"shard.kill.{self.shard}")
+            try:
+                faults.fault_point("shard.stall")
+                faults.fault_point(f"shard.stall.{self.shard}")
+            except Exception as e:
+                logger.warning("injected stall (%s): worker wedged", e)
+                while True:  # simulate a hung device op: alive, no progress
+                    time.sleep(60.0)
+            yield inst
+
+    def read_anchors(self, anchor_path: Optional[str] = None):
+        return self._reader.read_anchors(anchor_path)
+
+
+def run_worker(spec_path: str) -> int:
+    """Score one shard per its spec file; return the process exit code."""
+    from ..utils.platform import honor_platform_env
+
+    honor_platform_env()
+
+    from .. import telemetry
+    from ..archive import load_archive
+    from ..build import build_reader
+    from ..evaluate.predict_memory import SiamesePredictor
+    from ..resilience.io import atomic_write_text
+    from ..resilience.retry import RetryPolicy
+
+    spec = json.loads(Path(spec_path).read_text())
+    shard_dir = Path(spec["shard_dir"])
+    ev = spec["evaluation"]
+    tel = telemetry.configure(
+        run_dir=shard_dir,
+        heartbeat_every_s=float(spec["heartbeat_every_s"]),
+    )
+    try:
+        arch = load_archive(spec["archive"], overrides=spec.get("overrides"))
+        reader = build_reader(arch.config.get("dataset_reader"))
+        span_reader = SpanReader(
+            reader, spec["start"], spec["end"], spec["name"]
+        )
+        # no mesh in workers: each shard must score deterministically so
+        # merged metrics stay byte-identical to a single-process pass
+        # (scale comes from shard parallelism, not an in-worker mesh)
+        predictor = SiamesePredictor(
+            arch.model,
+            arch.params,
+            arch.tokenizer,
+            batch_size=int(ev["batch_size"]),
+            max_length=int(ev["max_length"]),
+            buckets=ev["buckets"],
+            tokens_per_batch=ev["tokens_per_batch"],
+            anchor_match_impl=ev["anchor_match_impl"],
+            aot_warmup=bool(ev["aot_warmup"]),
+        )
+        predictor.encode_anchors(reader.read_anchors(spec["golden_file"]))
+        # first liveness snapshot BEFORE scoring: model load + anchor
+        # encode can take minutes at real scale, and the supervisor's
+        # stall clock should start from real progress, not launch time
+        tel.heartbeat(force=True, rows_scored=0)
+        score_retries = int(ev["score_retries"])
+        metrics = predictor.predict_file(
+            span_reader,
+            spec["test_path"],
+            spec["out_path"],
+            split=spec.get("split"),
+            inflight=int(ev["inflight"]),
+            resume=True,
+            quarantine=ev["quarantine"],
+            heartbeat_batches=max(1, int(ev["heartbeat_batches"])),
+            retry_policy=RetryPolicy(attempts=score_retries)
+            if score_retries > 0 else None,
+            expected_reports=spec["end"] - spec["start"],
+            attribute_anchors=bool(ev["attribute_anchors"]),
+        )
+        # the completion marker commits atomically AFTER the journal
+        # drained: its presence + exit 0 is the shard's "done" claim
+        atomic_write_text(
+            shard_dir / "shard_metrics.json",
+            json.dumps({
+                "shard": spec["name"],
+                "span": [spec["start"], spec["end"]],
+                "rows": metrics.get("num_samples", 0),
+                "metrics": metrics,
+            }, default=str),
+        )
+        return 0
+    finally:
+        telemetry.write_programs(shard_dir)
+        tel.close()
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        # CLI usage text belongs on stderr, not in a logger
+        print(  # lint: disable=MV101
+            "usage: python -m memvul_tpu.distributed.worker <spec.json>",
+            file=sys.stderr,
+        )
+        return 2
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return run_worker(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

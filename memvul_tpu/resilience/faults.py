@@ -25,6 +25,16 @@ point              fires
 ``step.N``         at the start of optimizer step ``N`` (global step index)
 ``kernel.lower``   when the fused Pallas anchor-match kernel is selected,
                    before it is traced (simulates a Mosaic lowering failure)
+``shard.kill``     once per corpus row a shard worker yields
+                   (distributed/worker.py) — arm with ``sigkill`` to die
+                   like an OOM-killed host, mid-span, no handler;
+                   ``shard.kill.shard-<i>`` targets one shard
+``shard.stall``    same site — arm with a ``raise`` action and the worker
+                   wedges (alive, no progress) so the coordinator's
+                   heartbeat-age stall detector is what must catch it;
+                   ``shard.stall.shard-<i>`` targets one shard
+``merge.verify``   at merge-phase entry, before the exactly-once
+                   verification pass (distributed/coordinator.py)
 =================  ==========================================================
 
 With no configuration every point is a near-zero-cost no-op.  Arming is
@@ -41,7 +51,10 @@ Grammar: ``;``-separated clauses, each ``point[@n]=action`` —
   ``RuntimeError("injected fault")``);
 * ``sigterm`` / ``sigint``: deliver that signal to the current process
   (``os.kill`` — the delivery path is identical to an external kill, so
-  the handler under test is the production handler).
+  the handler under test is the production handler);
+* ``sigkill``: SIGKILL the current process — no handler runs, no
+  cleanup happens, exactly the OOM-killer / preemption-without-notice
+  failure the journal-resume paths must survive.
 
 Each clause fires exactly **once** and then disarms, so a retry loop
 that survives its injected failure proceeds normally — the property the
@@ -74,8 +87,13 @@ REGISTERED_POINTS = frozenset({
     "replica.kill",
     "bank.shadow",
     "kernel.lower",
+    "shard.kill",
+    "shard.stall",
+    "merge.verify",
 })
-REGISTERED_POINT_PREFIXES = ("step.", "replica.kill.")
+REGISTERED_POINT_PREFIXES = (
+    "step.", "replica.kill.", "shard.kill.", "shard.stall.",
+)
 
 _lock = threading.Lock()
 _faults: Dict[str, List["_Fault"]] = {}
@@ -87,7 +105,7 @@ _env_loaded = False
 class _Fault:
     point: str
     trigger: int = 1  # fire at the trigger-th hit of the point
-    action: str = "raise"  # "raise" | "sigterm" | "sigint"
+    action: str = "raise"  # "raise" | "sigterm" | "sigint" | "sigkill"
     exc_name: str = "RuntimeError"
     message: str = "injected fault"
     hits: int = 0
@@ -100,6 +118,11 @@ class _Fault:
             return
         if self.action == "sigint":
             os.kill(os.getpid(), signal.SIGINT)
+            return
+        if self.action == "sigkill":
+            # uncatchable by design: the process dies here, mid-write,
+            # mid-batch — whatever recovery exists must live on disk
+            os.kill(os.getpid(), signal.SIGKILL)
             return
         exc_type = getattr(builtins, self.exc_name, None)
         if not (isinstance(exc_type, type) and issubclass(exc_type, BaseException)):
@@ -134,7 +157,7 @@ def parse_spec(spec: str) -> List[_Fault]:
         fault = _Fault(point=target, trigger=trigger)
         parts = action.split(":", 2)
         kind = parts[0]
-        if kind in ("sigterm", "sigint"):
+        if kind in ("sigterm", "sigint", "sigkill"):
             if len(parts) > 1:
                 raise ValueError(f"fault clause {clause!r}: {kind} takes no arguments")
             fault.action = kind
@@ -147,7 +170,7 @@ def parse_spec(spec: str) -> List[_Fault]:
         else:
             raise ValueError(
                 f"fault clause {clause!r}: unknown action {kind!r} "
-                "(want raise[:Exc[:msg]] | sigterm | sigint)"
+                "(want raise[:Exc[:msg]] | sigterm | sigint | sigkill)"
             )
         out.append(fault)
     return out

@@ -44,22 +44,28 @@ def exception_text(exc: BaseException) -> str:
 
 @dataclasses.dataclass
 class RetryPolicy:
-    """Attempts + linear backoff + the shared transient classification.
+    """Attempts + backoff + the shared transient classification.
 
     ``delay(attempt)`` reproduces the bench supervisor's schedule
     (``backoff * attempt`` seconds after the attempt-th failure), so
     moving the supervisor onto this policy is behavior-preserving.
+    ``exponential=True`` switches to ``backoff * 2**(attempt-1)`` — the
+    shard supervisor's schedule (docs/full_corpus.md), where a flapping
+    worker must back off hard instead of hammering a sick host.
     """
 
     attempts: int = 3
     backoff: float = 2.0
     markers: Sequence[str] = RETRYABLE_MARKERS
     sleep: Callable[[float], None] = time.sleep
+    exponential: bool = False
 
     def is_transient(self, text: str) -> bool:
         return any(m in text for m in self.markers)
 
     def delay(self, attempt: int) -> float:
+        if self.exponential:
+            return self.backoff * (2 ** (max(1, attempt) - 1))
         return self.backoff * attempt
 
     def call(

@@ -164,6 +164,112 @@ def _replica_section(
     return lines
 
 
+_SHARD_EVENT_KINDS = frozenset({
+    "shard_start", "shard_restart", "shard_dead", "shard_stalled",
+    "shard_quarantined", "shard_done", "merge_verified",
+})
+
+
+def _shard_rows(
+    run_dir: Path, events: List[Dict[str, Any]], now: float
+) -> Dict[str, Any]:
+    """The data behind the SHARDS section (and the ``shards`` block of
+    the ``--json`` report): per-shard progress rows from each
+    ``shard-<i>/`` subdir's own sinks (a sharded ``score-corpus`` run,
+    docs/full_corpus.md), plus the coordinator's lifecycle event tallies
+    from the main stream — the ``_replica_rows`` pattern applied to the
+    offline map-reduce tier."""
+    shard_dirs = sorted(d for d in run_dir.glob("shard-*") if d.is_dir())
+    shard_events = [
+        ev for ev in events if ev.get("kind") in _SHARD_EVENT_KINDS
+    ]
+    restarts: Dict[str, int] = {}
+    quarantined: Dict[str, bool] = {}
+    done: Dict[str, bool] = {}
+    for ev in shard_events:
+        name = str(ev.get("shard", "?"))
+        if ev.get("kind") == "shard_restart":
+            restarts[name] = restarts.get(name, 0) + 1
+        elif ev.get("kind") == "shard_quarantined":
+            quarantined[name] = True
+        elif ev.get("kind") == "shard_done":
+            done[name] = True
+    rows: List[Dict[str, Any]] = []
+    for shard_dir in shard_dirs:
+        name = shard_dir.name
+        sub = load_run(shard_dir)
+        if not (sub["events"] or sub["summary"] or sub["heartbeat"]):
+            rows.append({"name": name, "recorded": False})
+            continue
+        heartbeat = sub["heartbeat"] or {}
+        counters = dict((sub["summary"] or {}).get("counters") or {})
+        if not counters:
+            counters = dict(heartbeat.get("counters") or {})
+        try:
+            age: Optional[float] = now - float(heartbeat.get("written_wall"))
+        except (TypeError, ValueError):
+            age = None
+        committed = heartbeat.get("rows_scored")
+        if committed is None:
+            committed = counters.get("journal.rows_committed", 0)
+        rows.append({
+            "name": name,
+            "recorded": True,
+            "heartbeat_age_s": age,
+            "rows_committed": committed,
+            "retries": counters.get("resilience.retries", 0),
+            "restarts": restarts.get(name, 0),
+            "quarantined": quarantined.get(name, False),
+            "done": done.get(name, False),
+        })
+    return {
+        "coordinator_events": len(shard_events),
+        "restarts": sum(restarts.values()),
+        "quarantined": sum(quarantined.values()),
+        "members": rows,
+    }
+
+
+def _shard_section(
+    run_dir: Path, events: List[Dict[str, Any]], now: float
+) -> List[str]:
+    """Per-shard rows for a sharded corpus-scoring run dir.  Always
+    rendered (the PROGRAMS pattern): a pre-existing run dir — or a
+    single-process one — says "(no shards recorded)" explicitly rather
+    than leaving the operator to wonder whether the section was
+    dropped.  A shard that never wrote telemetry (killed before its
+    first heartbeat) renders as an explicit row — its silence is the
+    post-mortem signal."""
+    data = _shard_rows(run_dir, events, now)
+    lines = ["SHARDS"]
+    if not (data["members"] or data["coordinator_events"]):
+        lines.append("  (no shards recorded)")
+        return lines
+    if data["coordinator_events"]:
+        lines.append(
+            f"  coordinator events: {data['coordinator_events']}"
+            + (f"  restarts: {data['restarts']}" if data["restarts"] else "")
+            + (f"  quarantined: {data['quarantined']}"
+               if data["quarantined"] else "")
+        )
+    for row in data["members"]:
+        if not row["recorded"]:
+            lines.append(f"  {row['name']}: (no telemetry recorded)")
+            continue
+        status = (
+            "quarantined" if row["quarantined"]
+            else "done" if row["done"] else "running"
+        )
+        lines.append(
+            f"  {row['name']}: heartbeat {_fmt_s(row['heartbeat_age_s'])} ago"
+            f"  rows={_fmt_num(row['rows_committed'])}"
+            f"  retries={_fmt_num(row['retries'])}"
+            f"  restarts={_fmt_num(row['restarts'])}"
+            f"  {status}"
+        )
+    return lines
+
+
 def _anchor_bank_section(
     run_dir: Path, counters: Dict[str, Any], summary: Dict[str, Any]
 ) -> List[str]:
@@ -441,7 +547,7 @@ def report_json(
     keys are pinned by tests (the ``lint --json`` pattern): ``schema``,
     ``run_dir``, ``events``, ``heartbeat``, ``spans``, ``counters``,
     ``gauges``, ``histograms``, ``derived``, ``latency_decomposition``,
-    ``replicas``, ``programs``, ``roofline``."""
+    ``replicas``, ``shards``, ``programs``, ``roofline``."""
     data = load_run(run_dir)
     now = time.time() if now is None else now
     summary = data["summary"]
@@ -475,6 +581,7 @@ def report_json(
         "derived": _derived_metrics(counters),
         "latency_decomposition": _latency_decomposition(histograms),
         "replicas": _replica_rows(data["run_dir"], data["events"], now),
+        "shards": _shard_rows(data["run_dir"], data["events"], now),
         "programs": programs["programs"],
         "roofline": programs["roofline"],
     }
@@ -517,6 +624,12 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
         if replica_lines:
             lines.append("")
             lines.extend(replica_lines)
+        # likewise shard-<i>/ sinks from a coordinator killed before its
+        # first event flush
+        shard_data = _shard_rows(data["run_dir"], events, now)
+        if shard_data["members"] or shard_data["coordinator_events"]:
+            lines.append("")
+            lines.extend(_shard_section(data["run_dir"], events, now))
         return "\n".join(lines)
     if not events:
         # heartbeat-/summary-only dirs (a SIGKILL before the first event
@@ -662,6 +775,10 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
     if replica_lines:
         lines.append("")
         lines.extend(replica_lines)
+
+    # -- shards (sharded corpus-scoring runs) ---------------------------------
+    lines.append("")
+    lines.extend(_shard_section(data["run_dir"], events, now))
 
     # -- last events ----------------------------------------------------------
     if events:

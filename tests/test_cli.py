@@ -702,7 +702,7 @@ def test_cli_help_names_every_registered_subcommand(capsys):
     assert {
         "train", "evaluate", "serve", "pretrain", "baseline", "build-data",
         "analyze", "bench", "bank", "telemetry-report", "doctor", "parity",
-        "selfcheck", "lint",
+        "selfcheck", "lint", "score-corpus",
     } <= names
     # every subcommand carries a non-empty one-line help
     helps = {ca.dest: ca.help for ca in sub._choices_actions}
@@ -744,6 +744,18 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         for flag in action.option_strings
     }
     assert "--json" in report_flags
+    # score-corpus's flag surface is pinned the same way: the sharding
+    # contract (docs/full_corpus.md "Sharded corpus scoring") rides on
+    # these knobs
+    corpus_flags = {
+        flag
+        for action in sub.choices["score-corpus"]._actions
+        for flag in action.option_strings
+    }
+    assert {
+        "--shards", "--out-dir", "--overrides", "--golden-file",
+        "--threshold", "--split",
+    } <= corpus_flags
 
 
 def test_cli_bank_help_names_every_lifecycle_subcommand(capsys):

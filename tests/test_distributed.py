@@ -1,0 +1,412 @@
+"""Chaos-hardened sharded corpus scoring (memvul_tpu/distributed/,
+docs/full_corpus.md "Sharded corpus scoring").
+
+The acceptance contracts proven here:
+
+* ``partition_rows`` is a pure, stable function of (corpus length,
+  shard count) — the exactly-once guarantee is vacuous without it;
+* a ``score_corpus`` run with one worker SIGKILLed mid-stream and a
+  transient ``score.batch`` fault injected in another still finishes
+  with exactly-once full coverage and merged metrics **byte-identical**
+  to an uninterrupted single-process run;
+* a shard that exhausts ``max_shard_attempts`` quarantines: the CLI
+  exits 3 with a machine-readable refusal naming the missing row spans,
+  and no merged metrics are produced;
+* the merge verifier rejects tampered output lines, missing rows
+  (naming their global spans), and journal claims outside a shard's
+  span — silent truncation is never an outcome;
+* ``telemetry-report`` renders a SHARDS section (with an explicit
+  "(no shards recorded)" fallback for non-sharded run dirs).
+
+Everything is CPU + tiny geometry; the two subprocess tests spawn real
+workers via ``python -m memvul_tpu.distributed.worker``.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from memvul_tpu import telemetry
+from memvul_tpu.data.synthetic import build_workspace
+from memvul_tpu.distributed import score_corpus
+from memvul_tpu.distributed.coordinator import (
+    MergeVerificationError,
+    _merge_and_verify,
+    _ShardState,
+    heartbeat_age_s,
+)
+from memvul_tpu.distributed.partition import partition_rows
+from memvul_tpu.evaluate.measure import cal_metrics
+from memvul_tpu.evaluate.predict_memory import SiamesePredictor
+from memvul_tpu.resilience import faults
+from memvul_tpu.resilience.journal import ScoreJournal
+from memvul_tpu.resilience.retry import RetryPolicy
+from memvul_tpu.telemetry.report import render_report, report_json
+
+pytestmark = pytest.mark.chaos
+
+WS_SEED = 7
+# the evaluation geometry shared by the archive (→ every worker) and the
+# single-process reference run: byte-identity only means something when
+# both paths score under one configuration
+EVAL_CFG = {
+    "batch_size": 8,
+    "max_length": 64,
+    "buckets": [32, 64],
+    "aot_warmup": False,
+    "heartbeat_batches": 1,
+    "shard_poll_interval_s": 0.2,
+    "shard_backoff_s": 0.2,
+    "shard_stall_timeout_s": 60.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    faults.reset()
+    yield
+    telemetry.reset()
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def ws(tmp_path_factory):
+    return build_workspace(tmp_path_factory.mktemp("dist"), seed=WS_SEED)
+
+
+@pytest.fixture(scope="module")
+def archive(ws, tmp_path_factory):
+    """A tiny untrained archive — weights don't matter for the
+    distribution machinery, determinism does."""
+    from memvul_tpu.archive import save_archive
+    from memvul_tpu.build import build_model, init_params
+
+    root = tmp_path_factory.mktemp("archive")
+    vocab = ws["tokenizer"].vocab_size
+    model_cfg = {
+        "type": "model_memory",
+        "encoder": {"preset": "tiny", "vocab_size": vocab},
+        "header_dim": 32,
+    }
+    config = {
+        "tokenizer": {
+            "type": "wordpiece", "tokenizer_path": ws["paths"]["tokenizer"],
+        },
+        "dataset_reader": {
+            "type": "reader_memory",
+            "anchor_path": ws["paths"]["anchors"],
+            "cve_path": ws["paths"]["cve"],
+        },
+        "model": model_cfg,
+        "evaluation": dict(EVAL_CFG),
+        "telemetry": {"heartbeat_every_s": 0.5},
+    }
+    model = build_model(dict(model_cfg), vocab)
+    params = init_params(model, seed=0)
+    return save_archive(
+        root / "model.tar.gz", config, params,
+        tokenizer_file=ws["paths"]["tokenizer"],
+    )
+
+
+@pytest.fixture(scope="module")
+def reference(ws, archive, tmp_path_factory):
+    """The uninterrupted single-process run every sharded result must
+    byte-match: same archive, same evaluation geometry, no mesh."""
+    from memvul_tpu.archive import load_archive
+    from memvul_tpu.build import build_reader
+
+    root = tmp_path_factory.mktemp("reference")
+    arch = load_archive(archive)
+    reader = build_reader(arch.config.get("dataset_reader"))
+    pred = SiamesePredictor(
+        arch.model, arch.params, arch.tokenizer,
+        batch_size=EVAL_CFG["batch_size"],
+        max_length=EVAL_CFG["max_length"],
+        buckets=EVAL_CFG["buckets"],
+        aot_warmup=EVAL_CFG["aot_warmup"],
+    )
+    pred.encode_anchors(reader.read_anchors(ws["paths"]["anchors"]))
+    out = root / "ref_result.json"
+    pred.predict_file(reader, ws["paths"]["test"], out)
+    metric = root / "ref_metric.json"
+    cal_metrics(out, thres=0.5, out_file=metric)
+    flat = [
+        r for line in out.read_text().splitlines() for r in json.loads(line)
+    ]
+    return {"metric": metric, "flat": flat}
+
+
+# -- partitioning -------------------------------------------------------------
+
+
+def test_partition_rows_pure_and_stable():
+    """The partition is pinned: changing it orphans every in-flight
+    shard journal (the resumed worker would replay the wrong span)."""
+    assert partition_rows(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert partition_rows(2, 4) == [(0, 1), (1, 2), (2, 2), (2, 2)]
+    assert partition_rows(0, 2) == [(0, 0), (0, 0)]
+    for n, k in [(1, 1), (7, 3), (100, 8), (5, 5), (0, 1)]:
+        spans = partition_rows(n, k)
+        # pure: same inputs, same spans
+        assert spans == partition_rows(n, k)
+        assert len(spans) == k
+        # contiguous, exactly-once coverage of range(n)
+        assert [i for s, e in spans for i in range(s, e)] == list(range(n))
+        # maximally even
+        sizes = [e - s for s, e in spans]
+        assert max(sizes) - min(sizes) <= 1
+    with pytest.raises(ValueError):
+        partition_rows(-1, 2)
+    with pytest.raises(ValueError):
+        partition_rows(5, 0)
+
+
+def test_heartbeat_age_resets_on_relaunch():
+    """The stall clock must not inherit a dead attempt's stale
+    HEARTBEAT.json — a restarted worker gets a fresh deadline."""
+    hb = {"written_wall": 100.0}
+    assert heartbeat_age_s(hb, 0.0, 130.0) == 30.0
+    # relaunched after the last write: age counts from the launch
+    assert heartbeat_age_s(hb, 125.0, 130.0) == 5.0
+    # no heartbeat, never launched: not stalled
+    assert heartbeat_age_s({}, 0.0, 130.0) == 0.0
+    assert heartbeat_age_s({"written_wall": "torn"}, 120.0, 130.0) == 10.0
+
+
+def test_retry_policy_exponential_backoff():
+    exp = RetryPolicy(attempts=4, backoff=2.0, exponential=True)
+    assert [exp.delay(a) for a in (1, 2, 3)] == [2.0, 4.0, 8.0]
+    # the default stays the historical linear ramp
+    lin = RetryPolicy(attempts=4, backoff=2.0)
+    assert [lin.delay(a) for a in (1, 2, 3)] == [2.0, 4.0, 6.0]
+
+
+# -- merge verification (unit: hand-built shard dirs) -------------------------
+
+
+def _write_shard(tmp_path, name, start, end, journal_rows=None):
+    """A shard dir whose out file + journal claim ``journal_rows``
+    (defaults to the full local span, one row per line)."""
+    shard_dir = tmp_path / name
+    shard_dir.mkdir(parents=True, exist_ok=True)
+    out = shard_dir / "r.json"
+    rows = list(range(end - start)) if journal_rows is None else journal_rows
+    lines = [
+        json.dumps([{"Issue_Url": f"u{start + r}"}]) for r in rows
+    ]
+    out.write_text("".join(line + "\n" for line in lines))
+    journal = ScoreJournal(str(out) + ".journal")
+    for i, (r, line) in enumerate(zip(rows, lines)):
+        journal.append(i, [r], line)
+    return _ShardState(
+        name=name, start=start, end=end, dir=shard_dir,
+        spec_path=shard_dir / "spec.json", out_path=out,
+    )
+
+
+def test_merge_verifier_rejects_tampered_line(tmp_path):
+    tel = telemetry.get_registry()
+    sh = _write_shard(tmp_path, "shard-0", 0, 3)
+    # corrupt the second output line after the journal committed it
+    lines = sh.out_path.read_text().splitlines()
+    lines[1] = json.dumps([{"Issue_Url": "tampered"}])
+    sh.out_path.write_text("".join(line + "\n" for line in lines))
+    with pytest.raises(MergeVerificationError) as exc:
+        _merge_and_verify(
+            [sh], 3, tmp_path / "m.json", tmp_path / "mm.json", 0.5, tel
+        )
+    reasons = [p["reason"] for p in exc.value.payload["problems"]]
+    assert any("checksum" in r for r in reasons)
+    assert exc.value.payload["status"] == "verification_failed"
+    assert not (tmp_path / "mm.json").exists()
+
+
+def test_merge_verifier_names_missing_global_spans(tmp_path):
+    tel = telemetry.get_registry()
+    # shard-1 owns global rows [3, 6) but journaled only local row 0
+    sh0 = _write_shard(tmp_path, "shard-0", 0, 3)
+    sh1 = _write_shard(tmp_path, "shard-1", 3, 6, journal_rows=[0])
+    with pytest.raises(MergeVerificationError) as exc:
+        _merge_and_verify(
+            [sh0, sh1], 6, tmp_path / "m.json", tmp_path / "mm.json", 0.5,
+            tel,
+        )
+    problems = exc.value.payload["problems"]
+    missing = [p for p in problems if "missing" in p["reason"]]
+    # the refusal names the gap in GLOBAL coordinates
+    assert missing and missing[0]["missing_spans"] == [[4, 6]]
+    assert missing[0]["shard"] == "shard-1"
+
+
+def test_merge_verifier_rejects_rows_outside_span(tmp_path):
+    tel = telemetry.get_registry()
+    # journal claims local rows 0..2 but the span only owns 2 rows
+    sh = _write_shard(tmp_path, "shard-0", 0, 2, journal_rows=[0, 1, 2])
+    with pytest.raises(MergeVerificationError) as exc:
+        _merge_and_verify(
+            [sh], 2, tmp_path / "m.json", tmp_path / "mm.json", 0.5, tel
+        )
+    reasons = [p["reason"] for p in exc.value.payload["problems"]]
+    assert any("outside the shard span" in r for r in reasons)
+
+
+def test_merge_verify_fault_point(tmp_path):
+    """merge.verify is a registered chaos hook: the merge phase itself
+    can be failure-injected."""
+    faults.configure("merge.verify=raise:RuntimeError:injected merge fault")
+    with pytest.raises(RuntimeError, match="injected merge fault"):
+        _merge_and_verify(
+            [], 0, tmp_path / "m.json", tmp_path / "mm.json", 0.5,
+            telemetry.get_registry(),
+        )
+
+
+# -- end-to-end chaos ---------------------------------------------------------
+
+
+def test_chaos_sigkill_and_transient_fault_byte_identical(
+    ws, archive, reference, tmp_path, monkeypatch
+):
+    """The headline acceptance run: SIGKILL one worker mid-stream and
+    inject a transient backend fault in the others — the supervised run
+    still converges to exactly-once coverage with merged metrics
+    byte-identical to the uninterrupted single-process reference."""
+    monkeypatch.setenv(
+        "MEMVUL_FAULTS",
+        "shard.kill.shard-1@3=sigkill;"
+        "score.batch@2=raise:RuntimeError:UNAVAILABLE injected",
+    )
+    out_dir = tmp_path / "run"
+    result = score_corpus(
+        archive, ws["paths"]["test"], out_dir, shards=2,
+        overrides={"evaluation": {"score_retries": 2}},
+    )
+
+    # the SIGKILLed shard was detected and relaunched
+    assert result["restarts"] >= 1
+    assert result["verification"]["exactly_once"] is True
+    assert result["corpus_rows"] == len(reference["flat"])
+    assert all(s["status"] == "done" for s in result["shards"])
+
+    # exactly-once full coverage: the merged record stream IS the
+    # reference's — same records, same order, nothing lost or doubled
+    flat = [
+        r for line in Path(result["out_results"]).read_text().splitlines()
+        for r in json.loads(line)
+    ]
+    assert [r["Issue_Url"] for r in flat] == [
+        r["Issue_Url"] for r in reference["flat"]
+    ]
+    assert flat == reference["flat"]
+    # merged metrics byte-identical to the uninterrupted run
+    assert (
+        Path(result["out_metrics"]).read_bytes()
+        == reference["metric"].read_bytes()
+    )
+
+    # the transient score.batch fault was retried inside a worker, not
+    # escalated to a restart
+    retries = 0
+    for shard_dir in sorted(out_dir.glob("shard-*")):
+        summary_path = shard_dir / "telemetry.json"
+        if summary_path.exists():
+            summary = json.loads(summary_path.read_text())
+            retries += int(
+                (summary.get("counters") or {}).get("resilience.retries", 0)
+            )
+    assert retries >= 1
+
+    # the per-shard progress gauges the live /metrics endpoint scrapes
+    # were published by the supervision loop
+    summary = json.loads((out_dir / "telemetry.json").read_text())
+    gauges = summary.get("gauges") or {}
+    assert "shard.rows_committed.shard-0" in gauges
+    assert "shard.rows_committed.shard-1" in gauges
+    assert "shard.heartbeat_age_s.shard-1" in gauges
+    assert "merge.rows_verified" in (summary.get("counters") or {})
+
+    # the coordinator journaled the lifecycle and the merge proof
+    events = [
+        json.loads(line)
+        for line in (out_dir / "events.jsonl").read_text().splitlines()
+    ]
+    kinds = [ev.get("kind") for ev in events]
+    assert "shard_restart" in kinds and "merge_verified" in kinds
+
+    # telemetry-report surfaces the per-shard rows (text + --json)
+    report = report_json(out_dir)
+    members = {m["name"]: m for m in report["shards"]["members"]}
+    assert set(members) == {"shard-0", "shard-1"}
+    assert report["shards"]["restarts"] >= 1
+    assert all(m["done"] for m in members.values())
+    text = render_report(out_dir)
+    assert "SHARDS" in text and "shard-1" in text
+
+
+def test_quarantine_partial_completion_exit_3(
+    ws, archive, reference, tmp_path, monkeypatch, capsys
+):
+    """A shard that exhausts max_shard_attempts quarantines: exit code 3
+    and a machine-readable refusal naming the missing spans — never
+    silently truncated metrics."""
+    from memvul_tpu.__main__ import main
+
+    monkeypatch.setenv("MEMVUL_FAULTS", "shard.kill.shard-0=sigkill")
+    out_dir = tmp_path / "run"
+    rc = main([
+        "score-corpus", str(archive), str(ws["paths"]["test"]),
+        "-o", str(out_dir), "--shards", "2",
+        "--overrides",
+        json.dumps({"evaluation": {"max_shard_attempts": 1}}),
+    ])
+    assert rc == 3
+
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    spans = partition_rows(len(reference["flat"]), 2)
+    assert payload["status"] == "partial"
+    assert payload["missing_spans"] == [list(spans[0])]
+    assert payload["rows_missing"] == spans[0][1] - spans[0][0]
+    assert payload["quarantined"][0]["shard"] == "shard-0"
+    assert payload["quarantined"][0]["failures"]
+    # no merged artifacts were produced for the partial run
+    assert not (out_dir / "model_memory_result.json").exists()
+    assert not (out_dir / "model_memory_metric_all.json").exists()
+
+
+# -- telemetry-report ---------------------------------------------------------
+
+
+def test_report_shards_section_and_fallback(tmp_path):
+    """The SHARDS section renders from coordinator events + shard-<i>/
+    sinks, and non-sharded run dirs say '(no shards recorded)'."""
+    run = tmp_path / "run"
+    reg = telemetry.configure(run_dir=run, heartbeat_every_s=0.0)
+    reg.event("shard_start", shard="shard-0")
+    reg.event("shard_restart", shard="shard-0", attempt=2)
+    reg.event("shard_done", shard="shard-0", attempt=2)
+    reg.close()
+    sub = telemetry.configure(run_dir=run / "shard-0", heartbeat_every_s=0.0)
+    sub.counter("journal.rows_committed").inc(5)
+    sub.heartbeat(force=True, rows_scored=5)
+    sub.close()
+
+    report = report_json(run)
+    assert report["shards"]["restarts"] == 1
+    member = report["shards"]["members"][0]
+    assert member["name"] == "shard-0"
+    assert member["rows_committed"] == 5
+    assert member["restarts"] == 1 and member["done"] is True
+    text = render_report(run)
+    assert "SHARDS" in text and "shard-0" in text
+
+    plain = tmp_path / "plain"
+    reg = telemetry.configure(run_dir=plain, heartbeat_every_s=0.0)
+    reg.counter("score.rows").inc(1)
+    reg.close()
+    report = report_json(plain)
+    assert report["shards"]["members"] == []
+    assert report["shards"]["coordinator_events"] == 0
+    assert "(no shards recorded)" in render_report(plain)
