@@ -47,6 +47,7 @@ from .promote import (  # noqa: F401
     PromotionRefused,
     demote,
     evaluate_candidate,
+    evaluate_cascade,
     evaluate_gate,
     golden_metrics,
     promote,

@@ -186,6 +186,76 @@ def evaluate_gate(
     )
 
 
+def evaluate_cascade(
+    predictor,
+    eval_instances: Iterable[Dict],
+    shadow_summary: Optional[Dict[str, Any]] = None,
+    thresholds: Optional[GateThresholds] = None,
+    threshold: float = 0.5,
+) -> PromotionDecision:
+    """Parity gate for the quantized cascade (docs/quantized_serving.md):
+    the same golden set scored twice through the SAME warmed predictor
+    and bank — the fp32 bucket grid as "active", the offline cascade
+    rule (int8 everywhere, in-band rows rescored fp32;
+    ``score_texts(impl="cascade")``) as "candidate" — then the standard
+    :func:`evaluate_gate` over AUC/F1 drop and decision flip rate.  A
+    mis-set band that lets uncertain rows short-circuit on int8 shows
+    up as flips and refuses with the machine-readable
+    ``{code, observed, limit}`` record.
+
+    ``shadow_summary`` is the live evidence when available: a
+    :class:`~memvul_tpu.bankops.shadow.ShadowScorer` attached to a
+    cascade service rescores served (cascade) traffic through the fp32
+    path, so its summary measures exactly served-vs-fp32 flips.
+    Without one, an offline flip summary over the golden set is
+    synthesized in the same shape (``flip`` = the ``threshold``
+    decision differs between the two scorings)."""
+    if getattr(predictor, "int8_params", None) is None:
+        raise ValueError(
+            "evaluate_cascade needs an encoder_precision='int8' predictor"
+        )
+    instances = list(eval_instances)
+    texts = [inst["text1"] for inst in instances]
+    metas = [inst.get("meta") or {} for inst in instances]
+    fp32 = predictor.score_texts(texts, impl="bucketed")
+    cascade = predictor.score_texts(texts, impl="cascade")
+
+    def _measured(probs) -> Dict[str, float]:
+        measure = SiameseMeasure()
+        measure.update(
+            probs.max(axis=-1) if instances else np.zeros((0,)), metas
+        )
+        out = measure.compute(reset=True)
+        out["n_eval"] = float(len(instances))
+        return out
+
+    if shadow_summary is None and instances:
+        best_active = fp32.max(axis=-1)
+        best_shadow = cascade.max(axis=-1)
+        flips = int(
+            ((best_active >= threshold) != (best_shadow >= threshold)).sum()
+        )
+        deltas = np.abs(best_shadow - best_active)
+        shadow_summary = {
+            "sampled": len(instances),
+            "flips": flips,
+            "flip_rate": flips / len(instances),
+            "anchor_changes": int(
+                (fp32.argmax(axis=-1) != cascade.argmax(axis=-1)).sum()
+            ),
+            "mean_abs_delta": float(deltas.mean()),
+            "max_abs_delta": float(deltas.max()),
+        }
+    return evaluate_gate(
+        _measured(fp32),
+        _measured(cascade),
+        shadow_summary,
+        thresholds=thresholds,
+        candidate="cascade",
+        parent="fp32",
+    )
+
+
 def evaluate_candidate(
     predictor,
     store: BankStore,

@@ -42,7 +42,10 @@ pass, BENCH_MICRO_{B,A,D,ITERS} set its shape; serve — drive the online
 scoring service (docs/serving.md) with closed-loop in-process clients
 and report request throughput + latency percentiles,
 BENCH_MICRO_REQUESTS/BENCH_MICRO_CLIENTS set the load,
-BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher;
+BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher,
+BENCH_SERVE_IMPL the dispatch strategy (bucketed | ragged | continuous |
+cascade | ab — ab drives all four over one seeded schedule),
+BENCH_CASCADE_BAND="low,high" the cascade leg's fp32 rescue band;
 train_step — A/B the Siamese train step's collation, pad-to-max vs
 bucketed+anchor-dedup over one identical pair stream, reporting padded-
 vs real-token throughput for both paths,
@@ -771,18 +774,36 @@ def _run_serve_micro() -> None:
         }
         for i in range(n_anchors)
     ]
-    # serve dispatch A/B (docs/ragged_serving.md, docs/serving.md):
-    # BENCH_SERVE_IMPL picks the dispatch strategy — "bucketed"
-    # (default), "ragged", "continuous", or "ab", which drives ALL
-    # THREE with the identical seeded schedule so one record quantifies
-    # both the padding win (real_token_utilization, ragged vs bucketed)
-    # and the admission win (queue_wait_gain, continuous vs ragged)
+    # serve dispatch A/B (docs/ragged_serving.md, docs/serving.md,
+    # docs/quantized_serving.md): BENCH_SERVE_IMPL picks the dispatch
+    # strategy — "bucketed" (default), "ragged", "continuous",
+    # "cascade" (int8 tier + fp32 rescue band), or "ab", which drives
+    # ALL FOUR with the identical seeded schedule so one record
+    # quantifies the padding win (real_token_utilization, ragged vs
+    # bucketed), the admission win (queue_wait_gain, continuous vs
+    # ragged), and the quantization win (cascade_rescore_rate + the
+    # cascade leg's throughput vs bucketed)
     impl_mode = os.environ.get("BENCH_SERVE_IMPL", "bucketed")
-    if impl_mode not in ("bucketed", "ragged", "continuous", "ab"):
+    if impl_mode not in ("bucketed", "ragged", "continuous", "cascade", "ab"):
         raise SystemExit(
-            "BENCH_SERVE_IMPL must be bucketed|ragged|continuous|ab, "
+            "BENCH_SERVE_IMPL must be bucketed|ragged|continuous|cascade|ab, "
             f"got {impl_mode!r}"
         )
+    # BENCH_CASCADE_BAND="low,high" sets the fp32 rescue band for the
+    # cascade leg (default: config.SERVING_DEFAULTS)
+    from memvul_tpu.config import SERVING_DEFAULTS as _serving_defaults
+
+    band_env = os.environ.get("BENCH_CASCADE_BAND")
+    if band_env:
+        try:
+            cascade_low, cascade_high = (float(x) for x in band_env.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"BENCH_CASCADE_BAND must be 'low,high', got {band_env!r}"
+            )
+    else:
+        cascade_low = float(_serving_defaults["cascade_low"])
+        cascade_high = float(_serving_defaults["cascade_high"])
     # the queue_wait comparison needs the per-stage trace histograms;
     # tracing stays off for single-leg runs so their numbers keep the
     # zero-overhead default (override with BENCH_SERVE_TRACE_RATE)
@@ -802,13 +823,18 @@ def _run_serve_micro() -> None:
     )
 
     def build_service(registry=None, impl: str = "bucketed") -> ScoringService:
-        kwargs = (
-            dict(
+        if impl in ("ragged", "continuous"):
+            kwargs = dict(
                 score_impl=impl, token_budget=token_budget,
                 max_rows_per_pack=max_batch,
             )
-            if impl in ("ragged", "continuous") else {}
-        )
+        elif impl == "cascade":
+            kwargs = dict(
+                score_impl="cascade", encoder_precision="int8",
+                cascade_low=cascade_low, cascade_high=cascade_high,
+            )
+        else:
+            kwargs = {}
         predictor = SiamesePredictor(
             model, params, ws["tokenizer"],
             batch_size=max_batch, max_length=seq_len, buckets=buckets,
@@ -896,7 +922,7 @@ def _run_serve_micro() -> None:
             lambda q: round(float(np.percentile(lat_ms, q)), 3)
             if len(lat_ms) else None
         )
-        return {
+        leg = {
             "impl": impl,
             "requests_per_sec": round(n_requests / elapsed, 1),
             "latency_ms": {
@@ -915,13 +941,30 @@ def _run_serve_micro() -> None:
             ),
             "queue_wait_ms": queue_wait_ms,
         }
+        if impl == "cascade":
+            # the quantization ledger: how much traffic the int8 tier
+            # answered alone vs re-dispatched into the fp32 rescue band
+            rescored = int(counters.get("serve.cascade_rescored", 0))
+            shortcut = int(counters.get("serve.cascade_shortcircuit", 0))
+            leg["cascade_rescored"] = rescored
+            leg["cascade_shortcircuit"] = shortcut
+            leg["cascade_rescore_rate"] = (
+                round(rescored / (rescored + shortcut), 4)
+                if (rescored + shortcut) else None
+            )
+            leg["cascade_band"] = [cascade_low, cascade_high]
+        return leg
 
     legs = (
-        ["bucketed", "ragged", "continuous"] if impl_mode == "ab"
+        ["bucketed", "ragged", "continuous", "cascade"] if impl_mode == "ab"
         else [impl_mode]
     )
     records = [_drive_leg(impl) for impl in legs]
-    primary = records[-1]  # continuous in ab mode; the single leg otherwise
+    by_leg = {leg["impl"]: leg for leg in records}
+    # the ab headline stays the continuous leg (the pre-cascade primary,
+    # so the metric's meaning is stable across records); single-leg runs
+    # report their own leg
+    primary = by_leg["continuous"] if impl_mode == "ab" else records[-1]
     record = {
         "metric": "serve_microbench",
         "value": primary["requests_per_sec"],
@@ -934,6 +977,14 @@ def _run_serve_micro() -> None:
         "padded_tokens": primary["padded_tokens"],
         "real_token_utilization": primary["real_token_utilization"],
         "queue_wait_ms": primary["queue_wait_ms"],
+        **{
+            k: primary[k]
+            for k in (
+                "cascade_rescored", "cascade_shortcircuit",
+                "cascade_rescore_rate", "cascade_band",
+            )
+            if k in primary
+        },
         "config": {
             "model": os.environ.get("BENCH_MODEL", "base"),
             "seq_len": seq_len,
@@ -948,7 +999,7 @@ def _run_serve_micro() -> None:
         **_program_blocks(),
     }
     if impl_mode == "ab":
-        by_impl = {leg["impl"]: leg for leg in records}
+        by_impl = by_leg
         record["ab"] = by_impl
         bucketed_util = by_impl["bucketed"]["real_token_utilization"]
         ragged_util = by_impl["ragged"]["real_token_utilization"]
@@ -964,6 +1015,16 @@ def _run_serve_micro() -> None:
             record["queue_wait_gain"] = round(
                 ragged_qw["p50"] / cont_qw["p50"], 2
             )
+        # the quantization win: cascade vs bucketed throughput over the
+        # identical schedule, plus how often the band forced a rescore
+        casc = by_impl.get("cascade")
+        if casc:
+            record["cascade_rescore_rate"] = casc["cascade_rescore_rate"]
+            bucketed_rps = by_impl["bucketed"]["requests_per_sec"]
+            if bucketed_rps:
+                record["cascade_throughput_gain"] = round(
+                    casc["requests_per_sec"] / bucketed_rps, 3
+                )
     print(json.dumps(record))
 
 

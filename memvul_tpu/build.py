@@ -410,10 +410,21 @@ def serve_from_archive(
     # envelope (budget covers max_batch typical-length requests only if
     # configured — the default 4×max_length favors a small warm program)
     score_impl = str(serve_cfg["score_impl"])
-    if score_impl not in ("bucketed", "ragged", "continuous"):
+    if score_impl not in ("bucketed", "ragged", "continuous", "cascade"):
         raise ValueError(
-            f"serving.score_impl must be 'bucketed', 'ragged' or "
-            f"'continuous', got {score_impl!r}"
+            f"serving.score_impl must be 'bucketed', 'ragged', "
+            f"'continuous' or 'cascade', got {score_impl!r}"
+        )
+    # quantized cascade (docs/quantized_serving.md): the predictor builds
+    # a second warmed int8 program family and the dispatcher re-routes
+    # only in-band rows to fp32
+    encoder_precision = "int8" if score_impl == "cascade" else "fp32"
+    cascade_low = float(serve_cfg["cascade_low"])
+    cascade_high = float(serve_cfg["cascade_high"])
+    if not (0.0 <= cascade_low <= cascade_high <= 1.0):
+        raise ValueError(
+            "serving.cascade_low/cascade_high must satisfy "
+            f"0 <= low <= high <= 1, got [{cascade_low!r}, {cascade_high!r}]"
         )
     token_budget = serve_cfg["token_budget"]
     token_budget = None if token_budget is None else int(token_budget)
@@ -515,6 +526,9 @@ def serve_from_archive(
             score_impl=score_impl,
             token_budget=token_budget,
             max_rows_per_pack=max_rows_per_pack,
+            encoder_precision=encoder_precision,
+            cascade_low=cascade_low,
+            cascade_high=cascade_high,
         )
         predictor.encode_anchors(anchors)
         return _with_slo_monitor(_with_drift_monitor(ScoringService(
@@ -550,6 +564,9 @@ def serve_from_archive(
                 score_impl=score_impl,
                 token_budget=token_budget,
                 max_rows_per_pack=max_rows_per_pack,
+                encoder_precision=encoder_precision,
+                cascade_low=cascade_low,
+                cascade_high=cascade_high,
                 # replica-private program registry, bound to the
                 # replica's telemetry: /programz fan-out and per-replica
                 # xla.* rows stay attributable to one device

@@ -337,10 +337,21 @@ SERVING_DEFAULTS: Dict[str, Any] = {
     # any length mix — instead of routing to bucket shapes
     # (docs/ragged_serving.md); "continuous" admits requests into the
     # in-flight pack persistently, decoupling queue wait from device
-    # latency (docs/serving.md, "Continuous admission")
+    # latency (docs/serving.md, "Continuous admission"); "cascade"
+    # scores every micro-batch on an int8 tier first and re-dispatches
+    # only rows whose max-anchor score lands inside the [cascade_low,
+    # cascade_high] uncertainty band to the fp32 program
+    # (docs/quantized_serving.md)
     "score_impl": "bucketed",    # "bucketed" | "ragged" | "continuous"
+                                 # | "cascade"
     "token_budget": None,        # ragged pack size (None → 4 × max_length)
     "max_rows_per_pack": None,   # ragged rows cap per pack (None → max_batch)
+    # cascade uncertainty band (inclusive; only read with
+    # score_impl="cascade"): rows with max-anchor probability inside
+    # [low, high] rescore in fp32, everything outside short-circuits
+    # on the int8 tier
+    "cascade_low": 0.3,
+    "cascade_high": 0.7,
     "host": "127.0.0.1",     # HTTP front-end bind address
     "port": 8341,            # HTTP front-end port
     # scale-out tier (serving/router.py; docs/serving.md "Replica tier").

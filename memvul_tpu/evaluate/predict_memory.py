@@ -66,6 +66,9 @@ class SiamesePredictor:
         token_budget: Optional[int] = None,
         max_rows_per_pack: Optional[int] = None,
         program_registry=None,
+        encoder_precision: str = "fp32",
+        cascade_low: float = 0.3,
+        cascade_high: float = 0.7,
     ) -> None:
         self.model = model
         self.mesh = mesh
@@ -88,18 +91,42 @@ class SiamesePredictor:
         # program over a fixed [1, token_budget] packed batch replaces
         # the per-bucket program grid; warmup/scoring/swap all route on
         # this knob, so the bucketed contract is untouched by default
-        if score_impl not in ("bucketed", "ragged", "continuous"):
+        if score_impl not in ("bucketed", "ragged", "continuous", "cascade"):
             raise ValueError(
-                f"score_impl must be 'bucketed', 'ragged' or 'continuous', "
-                f"got {score_impl!r}"
+                f"score_impl must be 'bucketed', 'ragged', 'continuous' or "
+                f"'cascade', got {score_impl!r}"
             )
-        if score_impl in ("ragged", "continuous") and mesh is not None:
+        if score_impl in ("ragged", "continuous", "cascade") and mesh is not None:
             raise ValueError(
-                f"score_impl={score_impl!r} serves a single-device predictor "
-                "(its packed batch has one row); scale out with serving "
-                "replicas, not a mesh"
+                f"score_impl={score_impl!r} serves a single-device predictor; "
+                "scale out with serving replicas, not a mesh"
+            )
+        if encoder_precision not in ("fp32", "int8"):
+            raise ValueError(
+                f"encoder_precision must be 'fp32' or 'int8', "
+                f"got {encoder_precision!r}"
+            )
+        if score_impl == "cascade" and encoder_precision != "int8":
+            raise ValueError(
+                "score_impl='cascade' needs the int8 tier: pass "
+                "encoder_precision='int8'"
+            )
+        if encoder_precision == "int8" and score_impl in ("ragged", "continuous"):
+            raise ValueError(
+                f"encoder_precision='int8' builds the bucketed program grid; "
+                f"score_impl={score_impl!r} is not cascadable"
+            )
+        if not (0.0 <= cascade_low <= cascade_high <= 1.0):
+            raise ValueError(
+                f"cascade band must satisfy 0 <= low <= high <= 1, got "
+                f"[{cascade_low!r}, {cascade_high!r}]"
             )
         self.score_impl = score_impl
+        self.encoder_precision = encoder_precision
+        # [low, high] max-anchor-probability band (inclusive): cascade
+        # rows landing inside are re-dispatched to the fp32 program,
+        # everything outside short-circuits on the int8 tier
+        self.cascade_band = (float(cascade_low), float(cascade_high))
         if token_budget is None:
             token_budget = 4 * max_length
         if token_budget < max_length:
@@ -141,6 +168,27 @@ class SiamesePredictor:
         # must stay flat for every shape in the bucket set
         self.score_trace_count = 0
 
+        # int8 tier: the SAME params serve a quantized twin of the model
+        # (BertConfig.quant="int8") whose per-column weight quant is
+        # cached ONCE here, at build time, into the "quant" collection —
+        # the jitted int8 forward then reads it as a plain input (no
+        # per-call re-quantization, no new checkpoint format)
+        self._int8_model = None
+        self.int8_params = None
+        if encoder_precision == "int8":
+            self.programs.mark_warm("score_int8", warm=False)
+            self._int8_model = self.model.clone(
+                config=self.model.config.replace(quant="int8")
+            )
+            dummy = {
+                "input_ids": np.zeros((1, 8), np.int32),
+                "attention_mask": np.ones((1, 8), np.int32),
+            }
+            _, qvars = self._int8_model.apply(
+                self.params, dummy, deterministic=True, mutable=["quant"]
+            )
+            self.int8_params = {**self.params, "quant": qvars["quant"]}
+
         self._encode_fn = jax.jit(
             lambda p, b: self.model.apply(p, b, deterministic=True)
         )
@@ -181,6 +229,21 @@ class SiamesePredictor:
             )
 
         self._ragged_score_fn = jax.jit(_score_ragged)
+
+        if self._int8_model is not None:
+            def _score_int8(p, b, bank):
+                self.score_trace_count += 1  # host-side, runs at trace only
+                self.programs.note_trace(
+                    "score_int8", self.int8_program_key(*b["input_ids"].shape)
+                )
+                return anchor_probs(
+                    self._int8_model.apply(
+                        p, b, anchors=bank, deterministic=True,
+                        anchor_impl=self.anchor_match_impl,
+                    )
+                )
+
+            self._int8_score_fn = jax.jit(_score_int8)
 
     def _maybe_degrade_to_xla(self, error: BaseException) -> bool:
         """Mosaic/Pallas failures that escaped the trace-time fallback in
@@ -315,6 +378,12 @@ class SiamesePredictor:
         tier's per-dispatch invocation accounting."""
         return f"score:{rows}x{length}"
 
+    def int8_program_key(self, rows: int, length: int) -> str:
+        """Program-registry key for one int8-tier score shape — its own
+        ``score_int8`` scope, so ``xla.membw_util``/``xla.mfu`` split by
+        tier and the memory-bound premise is checkable per device."""
+        return f"score_int8:{rows}x{length}"
+
     def ragged_program_key(self) -> str:
         """Program-registry key for the single ragged score program."""
         return (
@@ -412,11 +481,39 @@ class SiamesePredictor:
                     # the zero-mid-stream-compile contract still holds
                     return self.warmup_bank_shapes(bank)
         self.programs.mark_warm("score")
+        n_compiled = len(shapes)
+        if self._int8_model is not None:
+            # second warmed program family: the int8 tier compiles the
+            # same shape grid over the same (fp32-encoded) bank under its
+            # own scope, so a cascade never traces mid-serve on either
+            # tier and per-tier roofline gauges stay separable
+            self.programs.mark_warm("score_int8", warm=False)
+            with tel.span("aot_warmup", shapes=len(shapes)):
+                for rows, length in shapes:
+                    tel.progress()
+                    sample = {
+                        "input_ids": np.zeros((rows, length), np.int32),
+                        "attention_mask": np.ones((rows, length), np.int32),
+                    }
+                    try:
+                        self.programs.compile_and_register(
+                            self.int8_program_key(rows, length),
+                            self._int8_score_fn.lower(
+                                self.int8_params, sample, bank
+                            ),
+                            scope="score_int8",
+                        )
+                    except Exception as e:
+                        if not self._maybe_degrade_to_xla(e):
+                            raise
+                        return self.warmup_bank_shapes(bank)
+            self.programs.mark_warm("score_int8")
+            n_compiled += len(shapes)
         logger.info(
             "AOT warmup: %d score program(s) %s compiled in %.1fs",
-            len(shapes), shapes, time.perf_counter() - start,
+            n_compiled, shapes, time.perf_counter() - start,
         )
-        return len(shapes)
+        return n_compiled
 
     # -- phase 2: streaming scoring ------------------------------------------
 
@@ -542,6 +639,7 @@ class SiamesePredictor:
         texts: Sequence[str],
         bank_array=None,
         n_anchors: Optional[int] = None,
+        impl: Optional[str] = None,
     ) -> np.ndarray:
         """Score raw texts against a bank through THIS predictor's
         serving impl — bucketed texts route to their warmed bucket
@@ -551,7 +649,27 @@ class SiamesePredictor:
         is always computed the way the active service would have served
         it, whichever impl is live.  Returns ``[len(texts), n_anchors]``
         probabilities; ``bank_array``/``n_anchors`` default to the
-        predictor's own bank."""
+        predictor's own bank.
+
+        ``impl`` overrides the routing on an ``encoder_precision="int8"``
+        predictor: ``"bucketed"`` forces the fp32 bucket grid (the
+        default here even for ``score_impl="cascade"`` — a shadow tap on
+        a cascade service therefore rescores in fp32, which is exactly
+        the parity evidence the promotion gate wants), ``"int8"`` scores
+        everything on the quantized tier, ``"cascade"`` applies the
+        serving cascade rule offline: int8 everywhere, then rows whose
+        max-anchor score lands inside ``cascade_band`` (inclusive)
+        rescored through the fp32 program."""
+        if impl not in (None, "bucketed", "int8", "cascade"):
+            raise ValueError(
+                f"impl must be None, 'bucketed', 'int8' or 'cascade', "
+                f"got {impl!r}"
+            )
+        if impl in ("int8", "cascade") and self.int8_params is None:
+            raise RuntimeError(
+                f"impl={impl!r} needs the quantized tier: build the "
+                "predictor with encoder_precision='int8'"
+            )
         bank = self.anchor_bank if bank_array is None else bank_array
         n = self.n_anchors if n_anchors is None else int(n_anchors)
         if bank is None:
@@ -559,10 +677,10 @@ class SiamesePredictor:
         if not texts:
             return np.zeros((0, n), np.float32)
         seqs = self.encoder.encode_many(list(texts))
-        out = np.zeros((len(texts), n), np.float32)
-        if self.uses_ragged_program:
+        if impl is None and self.uses_ragged_program:
             from ..data.batching import collate_ragged, pack_token_budget
 
+            out = np.zeros((len(texts), n), np.float32)
             budget, max_rows = self.token_budget, self.max_rows_per_pack
             for pack in pack_token_budget(
                 [len(s) for s in seqs], budget, max_rows
@@ -577,6 +695,37 @@ class SiamesePredictor:
                 for row, i in zip(probs, pack):
                     out[i] = row
             return out
+        if impl == "int8":
+            return self._score_seqs_bucketed(
+                seqs, bank, n, self._int8_score_fn, self.int8_params
+            )
+        if impl == "cascade":
+            out = self._score_seqs_bucketed(
+                seqs, bank, n, self._int8_score_fn, self.int8_params
+            )
+            low, high = self.cascade_band
+            best = out.max(axis=1) if n else np.zeros(len(seqs))
+            band = [i for i in range(len(seqs)) if low <= best[i] <= high]
+            if band:
+                rescored = self._score_seqs_bucketed(
+                    [seqs[i] for i in band], bank, n,
+                    self._score_fn, self.params,
+                )
+                for row, i in zip(rescored, band):
+                    out[i] = row
+            return out
+        return self._score_seqs_bucketed(
+            seqs, bank, n, self._score_fn, self.params
+        )
+
+    def _score_seqs_bucketed(
+        self, seqs, bank, n: int, score_fn, params
+    ) -> np.ndarray:
+        """Score encoded sequences through a bucketed program grid —
+        grouped by smallest covering warmed length, chunked at the
+        bucket's row count, ``_pad_block`` layout (the serving
+        micro-batcher's exact geometry)."""
+        out = np.zeros((len(seqs), n), np.float32)
         rows_by_length = {
             length: rows for rows, length in self.stream_shapes()
         }
@@ -597,7 +746,7 @@ class SiamesePredictor:
                 )
                 if self.mesh is not None:
                     sample = shard_batch(sample, self.mesh)
-                dev = self._score_fn(self.params, sample, bank)
+                dev = score_fn(params, sample, bank)
                 probs = np.asarray(dev)[: len(chunk), :n]
                 for row, i in zip(probs, chunk):
                     out[i] = row

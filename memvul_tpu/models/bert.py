@@ -48,9 +48,12 @@ class BertConfig:
     # reference config, provided for drop-in parity)
     last_layer_only: bool = True
     # "int8_dynamic" routes the encoder's dense contractions through the
-    # MXU's native int8 path (inference-only speedup; same params/
-    # checkpoints — quantization is a property of the forward).  None =
-    # full precision
+    # MXU's native int8 path, re-quantizing weights inside every forward;
+    # "int8" additionally caches the per-column weight quant ONCE in the
+    # "quant" variable collection (materialize via one apply under
+    # mutable=["quant"] — SiamesePredictor does this at build time).
+    # Both are inference-only speedups over the SAME params/checkpoints —
+    # quantization is a property of the forward.  None = full precision
     quant: Optional[str] = None
     # bank-match backend for MemoryModel.match_anchors: "auto" runs the
     # fused Pallas kernel on TPU hardware and the jnp decomposition
@@ -109,6 +112,12 @@ def _dense(c: BertConfig, features: int, name: str):
         return QuantDense(
             features, dtype=c.dtype, kernel_init=_dense_init(c), name=name
         )
+    if c.quant == "int8":
+        from ..ops.quant import Int8Dense
+
+        return Int8Dense(
+            features, dtype=c.dtype, kernel_init=_dense_init(c), name=name
+        )
     if c.quant is not None:
         raise ValueError(f"unknown quant mode {c.quant!r}")
     return nn.Dense(features, kernel_init=_dense_init(c), dtype=c.dtype, name=name)
@@ -119,6 +128,13 @@ def _dense_general(c: BertConfig, features, name: str, axis=-1):
         from ..ops.quant import QuantDenseGeneral
 
         return QuantDenseGeneral(
+            features, axis=axis, dtype=c.dtype, kernel_init=_dense_init(c),
+            name=name,
+        )
+    if c.quant == "int8":
+        from ..ops.quant import Int8DenseGeneral
+
+        return Int8DenseGeneral(
             features, axis=axis, dtype=c.dtype, kernel_init=_dense_init(c),
             name=name,
         )

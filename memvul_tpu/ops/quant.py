@@ -59,6 +59,34 @@ def int8_matmul(x: jax.Array, w: jax.Array, out_dtype=jnp.float32) -> jax.Array:
     return (acc.astype(jnp.float32) * xs * ws[:, 0]).astype(out_dtype)
 
 
+def quantize_colwise(w: jax.Array):
+    """float [K, N] -> (int8 [K, N], f32 scales [N]) — per-output-column
+    symmetric scales.  Defined as ``quantize_rowwise`` of ``w.T``
+    transposed back, so a weight quantized ONCE here and contracted via
+    :func:`int8_matmul_prequant` is bitwise-equal to what
+    :func:`int8_matmul` derives dynamically on every call."""
+    wq_t, ws = quantize_rowwise(w.astype(jnp.float32).T)  # [N, K], [N, 1]
+    return wq_t.T, ws[:, 0]
+
+
+def int8_matmul_prequant(
+    x: jax.Array, wq: jax.Array, ws: jax.Array, out_dtype=jnp.float32
+) -> jax.Array:
+    """``x [..., K] @ dequant(wq [K, N], ws [N])`` with the weight half
+    already quantized (:func:`quantize_colwise`); activations are still
+    quantized per row dynamically inside the jitted forward.  The int32
+    accumulation is exact, so this is bitwise-equal to
+    ``int8_matmul(x, w)`` for ``wq, ws = quantize_colwise(w)``."""
+    xq, xs = quantize_rowwise(x)                       # [..., K], [..., 1]
+    acc = lax.dot_general(
+        xq,
+        wq,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                                  # [..., N] int32
+    return (acc.astype(jnp.float32) * xs * ws).astype(out_dtype)
+
+
 # -- flax layers (drop-in for the nn.Dense/DenseGeneral uses in bert.py) ----
 #
 # Param names and shapes are IDENTICAL to their flax counterparts, so one
@@ -112,5 +140,85 @@ class QuantDenseGeneral(nn.Module):
         n = math.prod(features)
         x2d = x.reshape(*x.shape[: x.ndim - len(axis)], k)
         y = int8_matmul(x2d, kernel.reshape(k, n), out_dtype=self.dtype)
+        y = y.reshape(*x.shape[: x.ndim - len(axis)], *features)
+        return y + bias.astype(self.dtype)
+
+
+# -- prequantized layers (quant="int8") -------------------------------------
+#
+# Same contraction as the Quant* twins above, but the weight half is
+# quantized ONCE and cached in the "quant" variable collection instead of
+# being re-quantized inside every forward — at serve batch sizes the
+# encoder is memory-bound, so re-reading fp32 weights just to re-derive
+# the same int8 copy wastes the bandwidth the quantization was meant to
+# save.  Materialize the cache with one apply under ``mutable=["quant"]``
+# (SiamesePredictor does this at build time); the jitted forward then
+# reads it as a plain input.  Param tree stays IDENTICAL to
+# nn.Dense/DenseGeneral — the cache is derived state, never checkpointed.
+
+
+class Int8Dense(nn.Module):
+    """nn.Dense with the contraction in int8 and the weight quantized once
+    (per-column, cached in the "quant" collection)."""
+
+    features: int
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features)
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        kernel_q = self.variable(
+            "quant", "kernel_q", lambda: quantize_colwise(kernel)[0]
+        )
+        kernel_scale = self.variable(
+            "quant", "kernel_scale", lambda: quantize_colwise(kernel)[1]
+        )
+        y = int8_matmul_prequant(
+            x, kernel_q.value, kernel_scale.value, out_dtype=self.dtype
+        )
+        return y + bias.astype(self.dtype)
+
+
+class Int8DenseGeneral(nn.Module):
+    """nn.DenseGeneral with the contraction in int8 and the weight
+    quantized once — supports the two shapes bert.py uses: fan-out to
+    (heads, head_dim) and fan-in from ``axis=(-2, -1)``."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Tuple[int, ...]] = -1
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        features = (
+            (self.features,) if isinstance(self.features, int) else tuple(self.features)
+        )
+        axis = (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        if sorted(a % x.ndim for a in axis) != list(
+            range(x.ndim - len(axis), x.ndim)
+        ):
+            raise ValueError(f"Int8DenseGeneral needs trailing axes, got {axis}")
+        in_shape = x.shape[x.ndim - len(axis):]
+        kernel = self.param(
+            "kernel", self.kernel_init, (*in_shape, *features)
+        )
+        bias = self.param("bias", nn.initializers.zeros, features)
+        k = math.prod(in_shape)
+        n = math.prod(features)
+        kernel_q = self.variable(
+            "quant", "kernel_q", lambda: quantize_colwise(kernel.reshape(k, n))[0]
+        )
+        kernel_scale = self.variable(
+            "quant", "kernel_scale", lambda: quantize_colwise(kernel.reshape(k, n))[1]
+        )
+        x2d = x.reshape(*x.shape[: x.ndim - len(axis)], k)
+        y = int8_matmul_prequant(
+            x2d, kernel_q.value, kernel_scale.value, out_dtype=self.dtype
+        )
         y = y.reshape(*x.shape[: x.ndim - len(axis)], *features)
         return y + bias.astype(self.dtype)

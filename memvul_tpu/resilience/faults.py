@@ -84,6 +84,7 @@ REGISTERED_POINTS = frozenset({
     "ckpt.write",
     "score.batch",
     "serve.batch",
+    "serve.cascade",
     "replica.kill",
     "bank.shadow",
     "kernel.lower",

@@ -28,7 +28,11 @@ device dispatches:
   overlap is measurable: ``serve.pack_topups`` counts admissions that
   happened while the device was busy, ``serve.pack_slots_reused``
   counts page-table slot recycling, and telemetry-report derives
-  ``serve.admission_efficiency`` from the pair.
+  ``serve.admission_efficiency`` from the pair;
+* :class:`CascadeDispatcher` — the quantized two-tier cascade
+  (docs/quantized_serving.md): bucketed routing, int8 first dispatch,
+  fp32 rescore of only the rows whose max-anchor score lands inside
+  the configured uncertainty band.
 
 The admission-path discipline is machine-checked: MV102 extends to
 ``*Dispatcher`` classes (no ``predict*``/``score_texts``/``time.sleep``
@@ -214,17 +218,53 @@ class Dispatcher:
         shape: str,
         program_key,
     ) -> None:
-        """One device dispatch at a warmed shape.  The ``serve.batch``
-        fault point fires inside the retried window; retry exhaustion
-        (or a non-transient failure) dead-letters the chunk — every
-        request resolves ``"error"`` with the reason — rather than
-        hanging its clients."""
+        """One device dispatch at a warmed shape, resolved to clients.
+        Composed from the three tier-sized pieces below — the cascade
+        strategy reuses them with a device call per tier, everything
+        else dispatches exactly once."""
+        probs = self._device_call(
+            chunk, bank, sample=sample, score_fn=score_fn,
+            shape=shape, program_key=program_key,
+        )
+        if probs is None:
+            return  # dead-lettered or killed: nothing left to resolve
+        self._finalize_batch(
+            len(chunk), occupancy_rows=occupancy_rows,
+            padded_tokens=padded_tokens, real_tokens=real_tokens,
+        )
+        self._resolve_scored(chunk, probs, bank)
+
+    def _device_call(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        bank: _BankVersion,
+        *,
+        sample: Dict[str, Any],
+        score_fn,
+        shape: str,
+        program_key,
+        params=None,
+        fault_name: str = "serve.batch",
+    ) -> Optional[np.ndarray]:
+        """One retried device round-trip.  The fault point
+        (``serve.batch``, or ``serve.cascade`` for the cascade's fp32
+        rescore) fires inside the retried window; retry exhaustion (or a
+        non-transient failure) dead-letters the chunk — every request
+        resolves ``"error"`` with the reason — rather than hanging its
+        clients.  Returns the ``[len(chunk), n_anchors]`` probabilities,
+        or ``None`` when the chunk dead-lettered or the worker was
+        killed (nothing left to resolve either way).  ``params``
+        defaults to the predictor's fp32 params; the cascade's int8 tier
+        passes ``predictor.int8_params``."""
         svc = self.service
         tel = svc._tel
 
         def once():
-            faults.fault_point("serve.batch")
-            return score_fn(svc.predictor.params, sample, bank.array)
+            faults.fault_point(fault_name)
+            return score_fn(
+                svc.predictor.params if params is None else params,
+                sample, bank.array,
+            )
 
         if svc._trace_enabled:
             # device_dispatch waypoint: tokenize/pad/pack is done, the
@@ -243,7 +283,7 @@ class Dispatcher:
             probs = np.asarray(dev)[: len(chunk), : bank.n_anchors]
         except Exception as e:
             if svc._killed.is_set():
-                return  # a killed worker neither counts nor resolves
+                return None  # a killed worker neither counts nor resolves
             reason = exception_text(e)
             logger.error(
                 "serve batch dead-lettered (%d request(s)): %s",
@@ -255,9 +295,9 @@ class Dispatcher:
             for request, _ in chunk:
                 request.future.resolve(dict(response))
                 svc._finish_trace(request, STATUS_ERROR)
-            return
+            return None
         if svc._killed.is_set():
-            return  # killed mid-dispatch: the sweep accounts this chunk
+            return None  # killed mid-dispatch: the sweep accounts this chunk
         if svc._trace_enabled:
             device_done = time.monotonic()
             for request, _ in chunk:
@@ -277,8 +317,22 @@ class Dispatcher:
             programs.record_invocation(
                 program_key(), time.perf_counter() - start
             )
+        return probs
+
+    def _finalize_batch(
+        self,
+        n_rows: int,
+        *,
+        occupancy_rows: int,
+        padded_tokens: int,
+        real_tokens: int,
+    ) -> None:
+        """Book one dispatched device batch into the occupancy/padding
+        ledger (counted per device round-trip: a cascade's fp32 rescore
+        is a second batch and pays a second entry)."""
+        tel = self.service._tel
         tel.histogram("serve.batch_occupancy").observe(
-            len(chunk) / occupancy_rows
+            n_rows / occupancy_rows
         )
         # the padding-efficiency ledger (docs/ragged_serving.md):
         # real tokens the requests carried vs token slots the dispatched
@@ -288,6 +342,20 @@ class Dispatcher:
         tel.counter("serve.tokens_real").inc(real_tokens)
         tel.counter("serve.tokens_padded").inc(padded_tokens)
         tel.counter("serve.batches").inc()
+
+    def _resolve_scored(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        probs: np.ndarray,
+        bank: _BankVersion,
+    ) -> None:
+        """Resolve scored rows to their clients: the served counter, the
+        per-request response + anchor attribution + stage histograms,
+        and the post-resolution shadow tap.  Every request passes
+        through here exactly once on the success path — the exact
+        counter invariant's served leg."""
+        svc = self.service
+        tel = svc._tel
         tel.counter("serve.served").inc(len(chunk))
         tel.progress()
         now = time.monotonic()
@@ -379,29 +447,51 @@ class BucketedDispatcher(Dispatcher):
             for start in range(0, len(group), rows):
                 if svc._killed.is_set():
                     return  # abandoned — the kill sweep takes over
-                chunk = group[start : start + rows]
-                sample = _pad_block(
-                    [seq for _, seq in chunk], rows,
-                    svc.predictor.encoder.pad_id, length,
+                self._score_bucket_chunk(
+                    group[start : start + rows], bank, rows, length
                 )
-                if svc.predictor.mesh is not None:
-                    from ..parallel.mesh import shard_batch
 
-                    sample = shard_batch(sample, svc.predictor.mesh)
-                self._score_chunk(
-                    chunk, bank,
-                    sample=sample,
-                    occupancy_rows=rows,
-                    padded_tokens=rows * length,
-                    real_tokens=sum(
-                        min(len(seq), length) for _, seq in chunk
-                    ),
-                    score_fn=svc.predictor._score_fn,
-                    shape=f"bucket:{rows}x{length} fill={len(chunk)}/{rows}",
-                    program_key=lambda rows=rows, length=length: (
-                        svc.predictor.bucket_program_key(rows, length)
-                    ),
-                )
+    def _pad_bucket(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        rows: int,
+        length: int,
+    ) -> Dict[str, Any]:
+        """The warmed (rows, length) block for one chunk — `_pad_block`
+        layout, mesh-sharded when the predictor carries a mesh."""
+        svc = self.service
+        sample = _pad_block(
+            [seq for _, seq in chunk], rows,
+            svc.predictor.encoder.pad_id, length,
+        )
+        if svc.predictor.mesh is not None:
+            from ..parallel.mesh import shard_batch
+
+            sample = shard_batch(sample, svc.predictor.mesh)
+        return sample
+
+    def _score_bucket_chunk(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        bank: _BankVersion,
+        rows: int,
+        length: int,
+    ) -> None:
+        svc = self.service
+        self._score_chunk(
+            chunk, bank,
+            sample=self._pad_bucket(chunk, rows, length),
+            occupancy_rows=rows,
+            padded_tokens=rows * length,
+            real_tokens=sum(
+                min(len(seq), length) for _, seq in chunk
+            ),
+            score_fn=svc.predictor._score_fn,
+            shape=f"bucket:{rows}x{length} fill={len(chunk)}/{rows}",
+            program_key=lambda: (
+                svc.predictor.bucket_program_key(rows, length)
+            ),
+        )
 
     def _bucket_for(self, n_tokens: int) -> int:
         """Smallest warmed bucket covering the token count (over-long
@@ -411,6 +501,92 @@ class BucketedDispatcher(Dispatcher):
             if length >= n_tokens:
                 return length
         return self.service._lengths[-1]
+
+
+class CascadeDispatcher(BucketedDispatcher):
+    """Two-tier quantized cascade (docs/quantized_serving.md): every
+    micro-batch scores on the int8 tier first, and only rows whose
+    max-anchor probability lands inside the ``[cascade_low,
+    cascade_high]`` uncertainty band (inclusive) are re-dispatched — at
+    the SAME warmed (rows, length) shape — to the fp32 program.
+    Confident negatives and positives short-circuit with their int8
+    scores; in-band rows resolve with fp32 scores bitwise-equal to the
+    bucketed strategy's.
+
+    Inherits the bucketed pull/coalesce/bucket-routing wholesale and
+    every base-class semantic: deadline-at-pull, shed/drain/hard-kill,
+    retry/dead-letter per device call (the rescore fires its own
+    ``serve.cascade`` fault point, so a failing fp32 tier dead-letters
+    only the in-band sub-chunk), ONE bank snapshot spanning both tiers
+    of a batch, and the trace waypoints — the ``dispatched`` waypoint's
+    shape label carries a tier tag, stamped per device call (an in-band
+    row's trace shows the fp32 dispatch that produced its score).
+
+    The tier split is observable: ``serve.cascade_shortcircuit`` /
+    ``serve.cascade_rescored`` count rows per exit, telemetry-report
+    derives ``serve.cascade_rescore_rate``, and each tier compiles
+    under its own program-registry scope (``score_int8`` vs ``score``)
+    so per-tier device time and roofline gauges stay separable."""
+
+    def _score_bucket_chunk(
+        self,
+        chunk: Sequence[Tuple[_Request, List[int]]],
+        bank: _BankVersion,
+        rows: int,
+        length: int,
+    ) -> None:
+        svc = self.service
+        predictor = svc.predictor
+        tel = svc._tel
+        probs = self._device_call(
+            chunk, bank,
+            sample=self._pad_bucket(chunk, rows, length),
+            score_fn=predictor._int8_score_fn,
+            params=predictor.int8_params,
+            shape=(
+                f"bucket:{rows}x{length} fill={len(chunk)}/{rows} tier=int8"
+            ),
+            program_key=lambda: predictor.int8_program_key(rows, length),
+        )
+        if probs is None:
+            return  # dead-lettered or killed: nothing left to resolve
+        self._finalize_batch(
+            len(chunk), occupancy_rows=rows,
+            padded_tokens=rows * length,
+            real_tokens=sum(min(len(seq), length) for _, seq in chunk),
+        )
+        low, high = getattr(predictor, "cascade_band", (0.3, 0.7))
+        best = probs.max(axis=1) if probs.size else np.zeros(len(chunk))
+        in_band = [i for i, b in enumerate(best) if low <= b <= high]
+        band_set = set(in_band)
+        confident = [i for i in range(len(chunk)) if i not in band_set]
+        if confident:
+            tel.counter("serve.cascade_shortcircuit").inc(len(confident))
+            self._resolve_scored(
+                [chunk[i] for i in confident], probs[confident], bank
+            )
+        if not in_band:
+            return
+        tel.counter("serve.cascade_rescored").inc(len(in_band))
+        sub = [chunk[i] for i in in_band]
+        rescored = self._device_call(
+            sub, bank,
+            sample=self._pad_bucket(sub, rows, length),
+            score_fn=predictor._score_fn,
+            shape=(
+                f"bucket:{rows}x{length} fill={len(sub)}/{rows} tier=fp32"
+            ),
+            program_key=lambda: predictor.bucket_program_key(rows, length),
+            fault_name="serve.cascade",
+        )
+        if rescored is None:
+            return  # the in-band sub-chunk dead-lettered (or killed)
+        self._finalize_batch(
+            len(sub), occupancy_rows=rows,
+            padded_tokens=rows * length,
+            real_tokens=sum(min(len(seq), length) for _, seq in sub),
+        )
+        self._resolve_scored(sub, rescored, bank)
 
 
 class RaggedDispatcher(Dispatcher):
@@ -723,14 +899,16 @@ _DISPATCHERS = {
     "bucketed": BucketedDispatcher,
     "ragged": RaggedDispatcher,
     "continuous": ContinuousDispatcher,
+    "cascade": CascadeDispatcher,
 }
 
 
 def make_dispatcher(service) -> Dispatcher:
     """The strategy for the service's predictor ``score_impl`` —
-    ``bucketed`` (PR 4), ``ragged`` (PR 8) or ``continuous`` (this
-    module).  The predictor has already validated the knob; this is the
-    belt-and-braces for duck-typed test fakes."""
+    ``bucketed`` (PR 4), ``ragged`` (PR 8), ``continuous`` (PR 12) or
+    ``cascade`` (docs/quantized_serving.md).  The predictor has already
+    validated the knob; this is the belt-and-braces for duck-typed test
+    fakes."""
     impl = service._score_impl
     try:
         return _DISPATCHERS[impl](service)

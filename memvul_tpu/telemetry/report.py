@@ -535,7 +535,53 @@ def _derived_metrics(counters: Dict[str, Any]) -> Dict[str, float]:
         # that joined a pack while the device was busy with another —
         # how much of the load actually overlapped the round-trip
         out["serve.admission_efficiency"] = topups / served
+    rescored = _as_num(counters.get("serve.cascade_rescored"))
+    shortcut = _as_num(counters.get("serve.cascade_shortcircuit"))
+    if rescored + shortcut > 0:
+        # cascade dispatch only: the fraction of served requests whose
+        # int8 score landed inside the uncertainty band and paid the
+        # fp32 rescore (docs/quantized_serving.md)
+        out["serve.cascade_rescore_rate"] = rescored / (rescored + shortcut)
     return out
+
+
+def _cascade_block(
+    counters: Dict[str, Any], programs: List[Dict[str, Any]]
+) -> Optional[Dict[str, Any]]:
+    """The ``cascade`` block of the ``--json`` report (and the CASCADE
+    text section): the tier split the CascadeDispatcher's counters
+    record, plus each tier's share of device time read from the program
+    registry's scope split (``score_int8:*`` = the int8 tier,
+    ``score:*`` = the fp32 tier).  None when the run never dispatched a
+    cascade batch."""
+    rescored = _as_num(counters.get("serve.cascade_rescored"))
+    shortcut = _as_num(counters.get("serve.cascade_shortcircuit"))
+    total = rescored + shortcut
+    if total <= 0:
+        return None
+    tiers: Dict[str, Dict[str, float]] = {}
+    for row in programs or []:
+        scope = row.get("scope")
+        tier = {"score_int8": "int8", "score": "fp32"}.get(scope)
+        if tier is None:
+            continue
+        t = tiers.setdefault(
+            tier, {"programs": 0.0, "invocations": 0.0, "device_time_s": 0.0}
+        )
+        t["programs"] += 1
+        t["invocations"] += _as_num(row.get("invocations"))
+        t["device_time_s"] += _as_num(row.get("device_time_s"))
+    device_total = sum(t["device_time_s"] for t in tiers.values())
+    for t in tiers.values():
+        t["device_time_share"] = (
+            t["device_time_s"] / device_total if device_total > 0 else 0.0
+        )
+    return {
+        "rescored": int(rescored),
+        "shortcircuit": int(shortcut),
+        "rescore_rate": rescored / total,
+        "tiers": tiers,
+    }
 
 
 def report_json(
@@ -547,7 +593,7 @@ def report_json(
     keys are pinned by tests (the ``lint --json`` pattern): ``schema``,
     ``run_dir``, ``events``, ``heartbeat``, ``spans``, ``counters``,
     ``gauges``, ``histograms``, ``derived``, ``latency_decomposition``,
-    ``replicas``, ``shards``, ``programs``, ``roofline``."""
+    ``cascade``, ``replicas``, ``shards``, ``programs``, ``roofline``."""
     data = load_run(run_dir)
     now = time.time() if now is None else now
     summary = data["summary"]
@@ -580,6 +626,7 @@ def report_json(
         "histograms": histograms,
         "derived": _derived_metrics(counters),
         "latency_decomposition": _latency_decomposition(histograms),
+        "cascade": _cascade_block(counters, programs["programs"]),
         "replicas": _replica_rows(data["run_dir"], data["events"], now),
         "shards": _shard_rows(data["run_dir"], data["events"], now),
         "programs": programs["programs"],
@@ -751,6 +798,17 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
                 f"  serve.admission_efficiency = {topups / served:.3f}"
                 f" ({int(topups)}/{int(served)} served admitted mid-flight)"
             )
+        # derived: cascade uncertainty-band pressure — served requests
+        # whose int8 score needed the fp32 rescore
+        # (docs/quantized_serving.md)
+        rescored = _as_num(counters.get("serve.cascade_rescored"))
+        shortcut = _as_num(counters.get("serve.cascade_shortcircuit"))
+        if rescored + shortcut > 0:
+            lines.append(
+                f"  serve.cascade_rescore_rate ="
+                f" {rescored / (rescored + shortcut):.3f}"
+                f" ({int(rescored)}/{int(rescored + shortcut)} rescored fp32)"
+            )
     gauges = summary.get("gauges") or {}
     if gauges:
         lines.append("")
@@ -765,10 +823,30 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
         lines.extend(anchor_lines)
 
     # -- compiled programs / roofline (telemetry/programs.py) ------------------
+    programs = _load_programs(data["run_dir"], events)
     lines.append("")
-    lines.extend(
-        _programs_section(_load_programs(data["run_dir"], events))
-    )
+    lines.extend(_programs_section(programs))
+
+    # -- quantized cascade tier split (docs/quantized_serving.md) --------------
+    cascade = _cascade_block(counters, programs["programs"])
+    if cascade:
+        lines.append("")
+        lines.append("CASCADE (int8 tier + fp32 rescue band)")
+        lines.append(
+            f"  shortcircuit(int8): {cascade['shortcircuit']}"
+            f"  rescored(fp32): {cascade['rescored']}"
+            f"  rescore_rate: {cascade['rescore_rate']:.3f}"
+        )
+        for tier in ("int8", "fp32"):
+            t = cascade["tiers"].get(tier)
+            if t is None:
+                continue
+            lines.append(
+                f"  {tier}: programs={int(t['programs'])}"
+                f"  invocations={int(t['invocations'])}"
+                f"  device_time={_fmt_s(t['device_time_s'])}"
+                f"  share={t['device_time_share']:.1%}"
+            )
 
     # -- replicas (scale-out serving runs) ------------------------------------
     replica_lines = _replica_section(data["run_dir"], events, now)

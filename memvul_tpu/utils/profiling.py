@@ -228,10 +228,16 @@ class ProfilerCapture:
         thread.start()
         return {"trace_dir": str(trace_dir), "seconds": seconds}
 
+    def _wait(self, seconds: float) -> None:
+        """Dwell inside the trace scope for the capture's duration.
+        A seam: tests replace it with an event wait so the busy window
+        is controlled instead of racing wall clock."""
+        time.sleep(seconds)
+
     def _run(self, trace_dir: Path, seconds: float) -> None:
         try:
             with trace_context(str(trace_dir)):
-                time.sleep(seconds)
+                self._wait(seconds)
         except Exception:  # pragma: no cover - a failed capture must
             pass           # never take the server with it
         finally:

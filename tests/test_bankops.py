@@ -45,6 +45,7 @@ from memvul_tpu.bankops import (
     ShadowConfig,
     ShadowScorer,
     demote,
+    evaluate_cascade,
     evaluate_gate,
     golden_metrics,
     pin_baseline,
@@ -602,6 +603,97 @@ def test_golden_metrics_smoke(setup, store_v2, ws):
     for key in ("auc", "f1", "precision", "recall"):
         assert key in metrics
     assert metrics["n_eval"] == 16
+
+
+# -- cascade parity gate (docs/quantized_serving.md) ---------------------------
+
+@pytest.fixture(scope="module")
+def cascade_gate_setup(ws):
+    """One tiny model + params shared by the cascade-gate tests; the band
+    varies per test, so the fixture returns a builder."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    anchors = list(reader.read_anchors(ws["paths"]["anchors"]))
+
+    def make(low, high):
+        predictor = SiamesePredictor(
+            model, params, ws["tokenizer"],
+            batch_size=8, max_length=48, buckets=[48],
+            encoder_precision="int8", score_impl="cascade",
+            cascade_low=low, cascade_high=high,
+        )
+        predictor.encode_anchors(anchors)
+        return predictor
+
+    instances = list(reader.read(ws["paths"]["test"], split="test"))
+    return {"make": make, "instances": instances}
+
+
+def test_evaluate_cascade_requires_int8_predictor(setup):
+    predictor, _reader, _texts = setup
+    with pytest.raises(ValueError, match="int8"):
+        evaluate_cascade(predictor, [])
+
+
+def test_evaluate_cascade_approves_and_prefers_live_shadow(cascade_gate_setup):
+    """A sane rescue band over the golden set approves: fp32-vs-cascade
+    deltas are quantization noise, zero decision flips — and a live
+    ShadowScorer summary, when supplied, is used verbatim instead of the
+    synthesized offline one."""
+    instances = cascade_gate_setup["instances"]
+    predictor = cascade_gate_setup["make"](0.3, 0.7)
+    decision = evaluate_cascade(
+        predictor, instances,
+        thresholds=GateThresholds(min_shadow_samples=10),
+    )
+    assert decision.approved and decision.reasons == []
+    assert decision.candidate == "cascade" and decision.parent == "fp32"
+    shadow = decision.metrics["shadow"]
+    assert shadow["sampled"] == len(instances)
+    assert shadow["flips"] == 0
+    assert shadow["max_abs_delta"] < 0.01
+    assert decision.metrics["candidate"]["n_eval"] == float(len(instances))
+
+    live = {"sampled": 500, "flips": 1, "flip_rate": 0.002}
+    with_live = evaluate_cascade(predictor, instances, shadow_summary=live)
+    assert with_live.approved
+    assert with_live.metrics["shadow"] == live
+
+
+def test_evaluate_cascade_refuses_lossy_band_machine_readably(
+    cascade_gate_setup,
+):
+    """A band that lets every row short-circuit on int8 (low == high == 0:
+    nothing is ever rescued) must refuse once the decision threshold sits
+    inside the quantization gap — with the standard machine-readable
+    ``{code, observed, limit}`` reason, not a vague failure."""
+    instances = cascade_gate_setup["instances"]
+    predictor = cascade_gate_setup["make"](0.0, 0.0)
+    texts = [inst["text1"] for inst in instances]
+    fp32 = predictor.score_texts(texts, impl="bucketed").max(axis=1)
+    int8 = predictor.score_texts(texts, impl="int8").max(axis=1)
+    deltas = np.abs(fp32 - int8)
+    row = int(deltas.argmax())
+    assert deltas[row] > 0  # quantization moves at least one best score
+    cut = float((fp32[row] + int8[row]) / 2.0)  # a flip by construction
+    decision = evaluate_cascade(
+        predictor, instances, threshold=cut,
+        thresholds=GateThresholds(max_flip_rate=0.0, min_shadow_samples=1),
+    )
+    assert not decision.approved
+    assert [r["code"] for r in decision.reasons] == [REASON_FLIP_RATE]
+    (reason,) = decision.reasons
+    assert set(reason) == {"code", "observed", "limit"}
+    assert reason["observed"] >= 1 / len(instances)
+    assert reason["limit"] == 0.0
 
 
 # -- offline attribution satellites --------------------------------------------

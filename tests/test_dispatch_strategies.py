@@ -52,7 +52,7 @@ from memvul_tpu.serving import (
 )
 from memvul_tpu.serving.loadgen import LoadConfig, LoadGenerator
 
-IMPLS = ["bucketed", "ragged", "continuous"]
+IMPLS = ["bucketed", "ragged", "continuous", "cascade"]
 
 # response status → the telemetry sub-counter that must match it exactly
 STATUS_TO_COUNTER = {
@@ -105,6 +105,11 @@ class _StrategyFake:
         self.device_s = device_s
         self.started = threading.Event()  # set when a batch enters scoring
         self.hold = threading.Event()     # scoring blocks until set
+        # cascade surface: the fake's int8 tier IS its score fn (max
+        # score 0.9 > high, so every row short-circuits — one device
+        # call per chunk, same counter semantics as the other impls)
+        self.int8_params = None
+        self.cascade_band = (0.3, 0.7)
 
     def stream_shapes(self):
         return list(self._shapes)
@@ -126,6 +131,12 @@ class _StrategyFake:
 
     def _ragged_score_fn(self, params, sample, bank):
         return self._score(self._rows)
+
+    def _int8_score_fn(self, params, sample, bank):
+        return self._score(sample["input_ids"].shape[0])
+
+    def int8_program_key(self, rows, length):
+        return f"score_int8:{rows}x{length}"
 
 
 def _make_service(impl, fake=None, **overrides):
@@ -404,6 +415,197 @@ def test_report_renders_admission_efficiency(tmp_path):
     assert "(30/40 served admitted mid-flight)" in text
     assert "serve.pack_slots_reused = 12" in text
     assert report["derived"]["serve.admission_efficiency"] == 0.75
+
+
+# -- cascade: int8 tier + fp32 rescue band -------------------------------------
+
+@pytest.fixture(scope="module")
+def cascade_setup(ws):
+    """One tiny model + params shared by every cascade predictor in this
+    section (the band varies per test) — warmed over ONE bucket, so each
+    predictor's warm-program set is exactly two: the fp32 bucket program
+    and its int8 twin."""
+    cfg = BertConfig.tiny(vocab_size=ws["tokenizer"].vocab_size)
+    model = MemoryModel(cfg)
+    dummy = {
+        "input_ids": np.zeros((2, 8), np.int32),
+        "attention_mask": np.ones((2, 8), np.int32),
+    }
+    params = model.init(jax.random.PRNGKey(0), dummy, dummy)
+    reader = MemoryReader(
+        cve_path=ws["paths"]["cve"], anchor_path=ws["paths"]["anchors"]
+    )
+    anchors = list(reader.read_anchors(ws["paths"]["anchors"]))
+
+    def make(low, high):
+        predictor = SiamesePredictor(
+            model, params, ws["tokenizer"],
+            batch_size=8, max_length=48, buckets=[48],
+            encoder_precision="int8", score_impl="cascade",
+            cascade_low=low, cascade_high=high,
+        )
+        predictor.encode_anchors(anchors)
+        return predictor
+
+    texts = [
+        inst["text1"]
+        for inst in reader.read(ws["paths"]["test"], split="test")
+    ]
+    return {"make": make, "texts": texts}
+
+
+def test_cascade_band_routes_int8_out_fp32_in(cascade_setup, tel):
+    """Out-of-band rows resolve with int8-tier scores, in-band rows with
+    fp32 scores — each bitwise-equal to the offline single-text score
+    through the same warmed program (the bucketed strategy's bitwise
+    contract, held per tier), with the tier-exit counters matching the
+    split exactly."""
+    texts = cascade_setup["texts"]
+    probe = cascade_setup["make"](0.0, 1.0)
+    best = probe.score_texts(texts, impl="int8").max(axis=1)
+    # cut the corpus on the int8 scores' midpoint: rows at or below it
+    # are "uncertain" (rescored fp32), rows above it short-circuit
+    cut = float((best.min() + best.max()) / 2.0)
+    predictor = cascade_setup["make"](0.0, cut)
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(
+            max_batch=8, max_wait_ms=1.0, max_queue=100,
+            default_deadline_ms=30000.0,
+        ),
+    )
+    client = InprocessClient(service)
+    labels = predictor.anchor_labels
+    n_in = n_out = 0
+    try:
+        for text, b in zip(texts, best):
+            response = client.score(text)
+            assert response["status"] == STATUS_OK
+            served = np.array(
+                [response["predict"][label] for label in labels], np.float32
+            )
+            if b <= cut:
+                expected = predictor.score_texts([text], impl="bucketed")[0]
+                n_in += 1
+            else:
+                expected = predictor.score_texts([text], impl="int8")[0]
+                n_out += 1
+            np.testing.assert_array_equal(served, expected)
+    finally:
+        service.drain()
+    assert n_in and n_out, "the midpoint cut must split the corpus"
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.cascade_rescored"] == n_in
+    assert counters["serve.cascade_shortcircuit"] == n_out
+
+
+def test_cascade_full_band_concurrent_parity_two_warm_programs(
+    cascade_setup, tel
+):
+    """Band [0, 1]: every row pays the fp32 rescore, so 200 concurrent
+    requests through a CASCADE service match the offline fp32 path
+    ≤1e-6 with ``score_trace_count`` flat — the whole load ran on
+    exactly the two warmed programs (one per tier), zero mid-serve
+    compiles."""
+    predictor = cascade_setup["make"](0.0, 1.0)
+    texts = cascade_setup["texts"]
+    n = 200
+    picks = [texts[i % len(texts)] for i in range(n)]
+    expected = predictor.score_texts(picks, impl="bucketed")
+    traces_before = predictor.score_trace_count
+    programs_before = {p["key"] for p in predictor.programs.snapshot()}
+    rows, length = predictor.stream_shapes()[0]
+    assert predictor.bucket_program_key(rows, length) in programs_before
+    assert predictor.int8_program_key(rows, length) in programs_before
+
+    service = ScoringService(
+        predictor,
+        config=ServiceConfig(
+            max_batch=8, max_wait_ms=3.0, max_queue=1000,
+            default_deadline_ms=30000.0,
+        ),
+    )
+    client = InprocessClient(service)
+    results = {}
+    lock = threading.Lock()
+
+    def worker(indices):
+        for i in indices:
+            response = client.score(picks[i])
+            with lock:
+                results[i] = response
+
+    threads = [
+        threading.Thread(target=worker, args=(range(k, n, 16),))
+        for k in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    service.drain()
+
+    assert len(results) == n
+    labels = predictor.anchor_labels
+    for i in range(n):
+        assert results[i]["status"] == STATUS_OK
+        got = np.array(
+            [results[i]["predict"][label] for label in labels], np.float32
+        )
+        np.testing.assert_allclose(got, expected[i], atol=1e-6, rtol=0)
+    # zero mid-serve compiles: the load ran entirely on the warmed pair
+    assert predictor.score_trace_count == traces_before
+    assert {p["key"] for p in predictor.programs.snapshot()} == programs_before
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.served"] == n
+    assert counters["serve.cascade_rescored"] == n
+    assert counters.get("serve.cascade_shortcircuit", 0) == 0
+    # every cascade batch booked two device round-trips into the ledger
+    assert counters["serve.batches"] % 2 == 0
+
+
+def test_report_renders_cascade_tier_split(tmp_path):
+    """telemetry-report derives serve.cascade_rescore_rate from the
+    tier-exit counters and renders the CASCADE section — tier split plus
+    each tier's device-time share from the program registry's scope
+    split — in both the text report and the --json block."""
+    from memvul_tpu.telemetry.report import render_report, report_json
+
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    registry.counter("serve.cascade_rescored").inc(10)
+    registry.counter("serve.cascade_shortcircuit").inc(30)
+    registry.close()
+    (tmp_path / "run" / "programs.json").write_text(json.dumps({
+        "programs": [
+            {"key": "score:8x48", "scope": "score",
+             "invocations": 10, "device_time_s": 3.0},
+            {"key": "score_int8:8x48", "scope": "score_int8",
+             "invocations": 40, "device_time_s": 1.0},
+        ],
+    }))
+    try:
+        text = render_report(tmp_path / "run")
+        report = report_json(tmp_path / "run")
+    finally:
+        telemetry.reset()
+    assert "serve.cascade_rescore_rate = 0.250" in text
+    assert "(10/40 rescored fp32)" in text
+    assert "CASCADE (int8 tier + fp32 rescue band)" in text
+    assert report["derived"]["serve.cascade_rescore_rate"] == 0.25
+    cascade = report["cascade"]
+    assert cascade["rescored"] == 10 and cascade["shortcircuit"] == 30
+    assert cascade["rescore_rate"] == 0.25
+    assert cascade["tiers"]["fp32"]["device_time_share"] == 0.75
+    assert cascade["tiers"]["int8"]["device_time_share"] == 0.25
+    # a run with no cascade traffic renders neither
+    other = telemetry.configure(run_dir=tmp_path / "plain")
+    other.counter("serve.served").inc(5)
+    other.close()
+    try:
+        assert "CASCADE" not in render_report(tmp_path / "plain")
+        assert report_json(tmp_path / "plain")["cascade"] is None
+    finally:
+        telemetry.reset()
 
 
 # -- the headline: admission decoupled from device latency ---------------------

@@ -591,10 +591,11 @@ def test_serve_from_archive_ragged_end_to_end(ws, tmp_path, tel):
 
 def test_serve_microbench_ab_emits_token_ledger(monkeypatch, capsys):
     """BENCH_MICRO=serve BENCH_SERVE_IMPL=ab at tiny geometry: one
-    parseable record with all three legs' real/padded token counts,
+    parseable record with all four legs' real/padded token counts,
     ragged real_token_utilization above bucketed on the same seeded
-    skewed schedule, and the continuous leg's queue-wait ledger — the
-    CPU-runnable shape of the owed on-hardware datapoint."""
+    skewed schedule, the continuous leg's queue-wait ledger, and the
+    cascade leg's tier-split ledger — the CPU-runnable shape of the
+    owed on-hardware datapoint."""
     from memvul_tpu import bench
 
     monkeypatch.setenv("BENCH_MICRO", "serve")
@@ -612,7 +613,7 @@ def test_serve_microbench_ab_emits_token_ledger(monkeypatch, capsys):
     assert record["metric"] == "serve_microbench"
     assert record["config"]["impl_mode"] == "ab"
     legs = record["ab"]
-    assert set(legs) == {"bucketed", "ragged", "continuous"}
+    assert set(legs) == {"bucketed", "ragged", "continuous", "cascade"}
     for leg in legs.values():
         assert leg["errors"] == 0
         assert leg["real_tokens"] > 0
@@ -632,3 +633,12 @@ def test_serve_microbench_ab_emits_token_ledger(monkeypatch, capsys):
     assert record["queue_wait_gain"] > 0
     assert record["impl"] == "continuous"
     assert record["value"] > 0
+    # the cascade leg's headline pair: how much traffic the band rescued
+    # and the cascade-vs-bucketed throughput ratio (the ≥2× bar needs the
+    # MXU int8 rate — on CPU only presence and consistency are pinned)
+    casc = legs["cascade"]
+    # every request exits exactly one tier (+1 for the warmup trickle,
+    # which lands in the leg's registry like everything else)
+    assert casc["cascade_rescored"] + casc["cascade_shortcircuit"] == 49
+    assert record["cascade_rescore_rate"] == casc["cascade_rescore_rate"]
+    assert record["cascade_throughput_gain"] > 0

@@ -869,6 +869,11 @@ def test_profilez_capture_conflict_and_disabled(tel, tmp_path):
         except urllib.error.HTTPError as e:
             return e.code, json.loads(e.read())
 
+    # hold the capture open on an event so the in-flight window is
+    # under test control — a timed sleep races the HTTP round-trips
+    # on a loaded machine
+    release = threading.Event()
+    server.profiler._wait = lambda seconds: release.wait(timeout=10)
     try:
         status, payload = post(server, {"seconds": 0.4})
         assert status == 200 and payload["status"] == "ok"
@@ -878,6 +883,7 @@ def test_profilez_capture_conflict_and_disabled(tel, tmp_path):
         assert status == 409 and "already running" in payload["reason"]
         # serving continues during the capture
         assert service.submit("live").result(timeout=10)["status"] == STATUS_OK
+        release.set()
         deadline = time.monotonic() + 10
         while server.profiler.busy and time.monotonic() < deadline:
             time.sleep(0.02)

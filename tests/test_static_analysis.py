@@ -770,3 +770,51 @@ def test_dispatcher_admission_path_known_bad(tmp_path):
         ("pkg/bad_dispatch.py", 7, "score_texts"),
         ("pkg/bad_dispatch.py", 9, "predict_file"),
     ], hits
+
+
+def test_cascade_dispatcher_admission_path_known_bad(tmp_path):
+    """The cascade tier (serving/dispatch.py CascadeDispatcher) inherits
+    the MV102 admission discipline through the ``*Dispatcher`` name match:
+    a future cascade that rescues the in-band rows through the synchronous
+    ``score_texts`` convenience (one device round-trip per request) or
+    polls the fp32 tier with a bare ``sleep`` fails — while the real
+    two-tier surface (both jitted score fns, band masking, counters) stays
+    legal, so the checker cannot be satisfied by gutting the rescue."""
+    _write_tree(tmp_path, {
+        "pkg/bad_cascade.py": (
+            "import time\n"
+            "class BucketedDispatcher:\n"
+            "    pass\n"
+            "class LazyCascadeDispatcher(BucketedDispatcher):\n"
+            "    def _score_bucket_chunk(self, chunk):\n"
+            "        probs = self.predictor.score_texts(\n"
+            "            [e.text for e in chunk], impl='int8')\n"
+            "        time.sleep(0.01)\n"
+            "        return probs\n"
+        ),
+        "pkg/good_cascade.py": (
+            "import numpy as np\n"
+            "class CascadeDispatcher:\n"
+            "    def _score_bucket_chunk(self, chunk, sample, bank):\n"
+            "        cheap = self.predictor._int8_score_fn(\n"
+            "            self.predictor.int8_params, sample, bank)\n"
+            "        low, high = self.predictor.cascade_band\n"
+            "        best = np.asarray(cheap).max(axis=-1)\n"
+            "        in_band = (best >= low) & (best <= high)\n"
+            "        if in_band.any():\n"
+            "            self.telemetry.increment(\n"
+            "                'serve.cascade_rescored', int(in_band.sum()))\n"
+            "            exact = self.predictor._score_fn(\n"
+            "                self.predictor.params, sample, bank)\n"
+            "            return np.where(in_band[:, None], exact, cheap)\n"
+            "        return cheap\n"
+        ),
+    })
+    result = _analyze_fixture(tmp_path, select=["MV102"])
+    hits = sorted(
+        (f.path, f.line, f.symbol) for f in result.active
+    )
+    assert hits == [
+        ("pkg/bad_cascade.py", 6, "score_texts"),
+        ("pkg/bad_cascade.py", 8, "sleep"),
+    ], hits
