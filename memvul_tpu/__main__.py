@@ -349,15 +349,38 @@ def cmd_serve(args) -> int:
 
     mesh = _parse_mesh(args.mesh)
     try:
-        service = serve_from_archive(
-            args.archive,
-            out_dir=args.out_dir,
-            overrides=args.overrides,
-            golden_file=args.golden_file,
-            mesh=mesh,
-            use_mesh=not args.no_mesh,
-            replicas=args.replicas,
-        )
+        if getattr(args, "hosts", None):
+            # cross-host fleet mode (serving/fleet.py): front a
+            # HostBalancer over already-running per-host serve
+            # processes — no archive/model load on the balancer host
+            from .serving.fleet import (
+                FleetConfig, HostBalancer, ProcessHost, enumerate_hosts,
+            )
+
+            urls = enumerate_hosts(args.hosts, default_port=args.port)
+            if not urls:
+                print("serve: --hosts resolved no hosts", file=sys.stderr)
+                return 2
+            service = HostBalancer(
+                [ProcessHost(i, url=u) for i, u in enumerate(urls)],
+                config=FleetConfig(),
+            )
+        else:
+            if not args.archive:
+                print(
+                    "serve: an archive is required (or pass --hosts)",
+                    file=sys.stderr,
+                )
+                return 2
+            service = serve_from_archive(
+                args.archive,
+                out_dir=args.out_dir,
+                overrides=args.overrides,
+                golden_file=args.golden_file,
+                mesh=mesh,
+                use_mesh=not args.no_mesh,
+                replicas=args.replicas,
+            )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
         return 2
@@ -380,6 +403,7 @@ def cmd_serve(args) -> int:
         "serving": f"http://{bound_host}:{bound_port}",
         "pid": os.getpid(),
         "replicas": len(getattr(service, "replicas", ())) or 1,
+        "hosts": len(getattr(service, "hosts", ())) or None,
     }))
     sys.stdout.flush()
     try:
@@ -387,7 +411,7 @@ def cmd_serve(args) -> int:
             stop.wait(0.5)
     finally:
         server.shutdown()
-        for attr in ("drift_monitor", "slo_monitor"):
+        for attr in ("drift_monitor", "slo_monitor", "autoscaler"):
             monitor = getattr(service, attr, None)
             if monitor is not None:
                 monitor.stop()
@@ -778,9 +802,19 @@ def build_parser() -> argparse.ArgumentParser:
         "/healthz, GET /metrics Prometheus scrape, GET /tracez request "
         "traces, POST /profilez on-demand profiler capture), graceful "
         "SIGTERM drain; --replicas N runs a health-gated multi-replica "
-        "router, one service per local device (docs/serving.md)",
+        "router, one service per local device; --hosts fronts a cross-"
+        "host balancer over already-running serve processes "
+        "(docs/serving.md)",
     )
-    p.add_argument("archive", help="model.tar.gz or its serialization dir")
+    p.add_argument("archive", nargs="?", default=None,
+                   help="model.tar.gz or its serialization dir "
+                   "(not needed with --hosts)")
+    p.add_argument("--hosts", default=None,
+                   help="comma-separated host[:port] or URLs of running "
+                   "serve processes to balance across (or set "
+                   "MEMVUL_FLEET_HOSTS); merges /healthz, /metrics, "
+                   "/tracez, /programz and routes around dead or "
+                   "stalled hosts (docs/serving.md, 'Cross-host fleet')")
     p.add_argument("-o", "--out-dir", default=None,
                    help="run dir for telemetry sinks + the anchor-bank "
                    "manifest (default: no sinks; replicas write "

@@ -2,7 +2,7 @@
 dispatcher admission paths never block.
 
 Migrated from ``tools/lint_no_blocking_in_handler.py`` (now a
-delegating shim).  Three class families, wherever they live:
+delegating shim).  Four class families, wherever they live:
 
 * classes with a base whose name ends with ``RequestHandler`` — one
   thread per connection; anything blocking serializes the whole server
@@ -11,6 +11,12 @@ delegating shim).  Three class families, wherever they live:
 * classes named ``*Router`` (or deriving from one) — a routing decision
   reads queue depths and picks a replica, nothing more; heavy fleet
   operations belong to control-plane workers;
+* classes named ``*Balancer`` or ``*Autoscaler`` (or deriving from
+  one; serving/fleet.py, serving/autoscaler.py) — the same selection-
+  only discipline one level up: a host-routing or scale decision reads
+  cached health/queue/hint state and picks; kills, restart backoff,
+  spawn warmups, and drain waits belong to the module-level recovery/
+  scale workers on their own threads;
 * classes named ``*Dispatcher`` (or deriving from one;
   serving/dispatch.py) — the batcher strategies themselves.  Their JOB
   is to encode, pack, and score, so the serving-surface names stay
@@ -94,6 +100,17 @@ def _is_router_class(node: ast.ClassDef) -> bool:
     return any(_base_name(b).endswith("Router") for b in node.bases)
 
 
+def _is_balancer_class(node: ast.ClassDef) -> bool:
+    # host balancers and autoscalers make routing/control decisions
+    # under the same selection-only contract as routers
+    for suffix in ("Balancer", "Autoscaler"):
+        if node.name.endswith(suffix):
+            return True
+        if any(_base_name(b).endswith(suffix) for b in node.bases):
+            return True
+    return False
+
+
 def _is_dispatcher_class(node: ast.ClassDef) -> bool:
     if node.name.endswith("Dispatcher"):
         return True
@@ -112,11 +129,16 @@ def check(ctx: AnalysisContext) -> Iterator[Finding]:
         for node in ast.walk(pf.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
-            if _is_handler_class(node) or _is_router_class(node):
+            if (
+                _is_handler_class(node)
+                or _is_router_class(node)
+                or _is_balancer_class(node)
+            ):
                 forbidden = FORBIDDEN_NAMES
                 contract = (
                     "a handler may only submit() and wait on the future; "
-                    "a router may only select a replica queue"
+                    "a router/balancer/autoscaler may only select from "
+                    "cached state"
                 )
             elif _is_dispatcher_class(node):
                 forbidden = DISPATCHER_FORBIDDEN_NAMES

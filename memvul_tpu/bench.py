@@ -843,7 +843,7 @@ def _run_serve_micro() -> None:
         predictor.encode_anchors(anchor_instances)
         return ScoringService(predictor, config=service_config, registry=registry)
 
-    if n_replicas > 1:
+    if n_replicas > 1 or os.environ.get("BENCH_SERVE_AUTOSCALE") == "1":
         router_impl = "bucketed" if impl_mode == "ab" else impl_mode
         _run_serve_router_micro(
             watchdog,
@@ -1037,7 +1037,16 @@ def _run_serve_router_micro(
     driven by the deterministic load generator, reported as one JSON
     record with per-cause outcome counts and per-replica utilization.
     CPU-runnable at tiny geometry; the recorded rps is only meaningful
-    at base geometry on hardware (ROADMAP chip-window item)."""
+    at base geometry on hardware (ROADMAP chip-window item).
+
+    Autoscale leg (BENCH_SERVE_AUTOSCALE=1; docs/serving.md,
+    "Autoscaling"): the fleet starts at ONE replica with an
+    :class:`~memvul_tpu.serving.Autoscaler` closing the scale_hint loop
+    (BENCH_SERVE_REPLICAS is the max), the pattern defaults to diurnal,
+    and the record gains the replica-count trajectory, per-phase SLO
+    burn over the diurnal cycle, scale-event counts, and the
+    lost-request count — which must be 0: every request is served,
+    shed, or errored somewhere, retirements included."""
     from memvul_tpu.serving import (
         LoadConfig,
         Replica,
@@ -1047,13 +1056,18 @@ def _run_serve_router_micro(
     )
     from memvul_tpu.telemetry.registry import TelemetryRegistry
 
-    pattern = os.environ.get("BENCH_SERVE_PATTERN", "closed")
+    autoscale = os.environ.get("BENCH_SERVE_AUTOSCALE") == "1"
+    pattern = os.environ.get(
+        "BENCH_SERVE_PATTERN", "diurnal" if autoscale else "closed"
+    )
     rps = float(os.environ.get("BENCH_SERVE_RPS", "200"))
+    diurnal_period_s = float(os.environ.get("BENCH_SERVE_PERIOD_S", "2.0"))
+    max_replicas = max(n_replicas, 2) if autoscale else n_replicas
     with watchdog.phase("replica_warmup"):
         replicas = [
             Replica(i, lambda registry: build_service(registry=registry),
                     telemetry_enabled=True)
-            for i in range(n_replicas)
+            for i in range(1 if autoscale else n_replicas)
         ]
     router_registry = TelemetryRegistry(enabled=True)
     router = ReplicaRouter(
@@ -1068,22 +1082,118 @@ def _run_serve_router_micro(
         config=SLOConfig(interval_s=1.0), start=False,
     )
     router.slo_monitor.tick()  # the pre-load baseline sample
+    scaler = None
+    driver_stop = threading.Event()
+    driver = None
+    if autoscale:
+        from memvul_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+
+        scaler = Autoscaler(
+            router,
+            replica_factory=lambda index: (
+                lambda registry: build_service(registry=registry)
+            ),
+            slo_monitor=router.slo_monitor,
+            # bench-tight stability knobs: the diurnal period is seconds,
+            # not hours, so cooldowns/hysteresis compress with it
+            config=AutoscalerConfig(
+                min_replicas=1, max_replicas=max_replicas,
+                interval_s=0.1, up_cooldown_s=0.3, down_cooldown_s=0.5,
+                up_consecutive=1, down_consecutive=2,
+                drain_timeout_s=30.0,
+            ),
+            registry=router_registry,
+            start=False,  # the driver thread below paces the ticks
+        )
+        router.autoscaler = scaler  # the harness record's status block
+
+        def _drive() -> None:
+            # the closed control loop: sample the SLO, act on the hint;
+            # sync=True keeps one spawn/retire at a time deterministic
+            while not driver_stop.wait(0.1):
+                try:
+                    router.slo_monitor.tick()
+                    scaler.tick(sync=True)
+                except Exception:
+                    pass  # one bad sample must not end the bench loop
+
+        driver = threading.Thread(
+            target=_drive, name="bench-autoscale-driver", daemon=True
+        )
     load = LoadConfig(
         pattern=pattern, requests=n_requests, clients=n_clients, rps=rps,
+        diurnal_period_s=diurnal_period_s,
         deadline_ms=None if pattern != "slowloris" else 60_000.0,
     )
     with watchdog.phase("serve_warmup"):
         router.submit(texts[0], deadline_ms=0).result(timeout=120)
     with watchdog.phase("serve_load"):
-        record = run_slo_harness(router, texts, config=load)
+        if driver is not None:
+            driver.start()
+        try:
+            record = run_slo_harness(router, texts, config=load)
+        finally:
+            driver_stop.set()
+            if driver is not None:
+                driver.join(timeout=30)
     router.drain()
 
     report = record["load"]
     fleet = record.get("fleet", {})
+    autoscale_block = None
+    if scaler is not None:
+        counters = router_registry.snapshot()["counters"]
+        members = fleet.get("replicas", [])
+        # the lost-request detector: hangs + any invariant deficit —
+        # a request admitted somewhere but never served/shed/errored
+        deficit = sum(
+            m["requests"] - m["served"] - m["shed"] - m["errors"]
+            for m in members
+        )
+        lost = report["outcomes"]["hang"] + max(0, deficit)
+        # per-phase SLO burn over the diurnal cycle: bucket the
+        # trajectory by quarter-period (rise/peak/fall/trough)
+        phase_names = ("rise", "peak", "fall", "trough")
+        phases = {name: [] for name in phase_names}
+        for point in scaler.history:
+            frac = (point["t_s"] % diurnal_period_s) / diurnal_period_s
+            phases[phase_names[min(3, int(frac * 4))]].append(point)
+        autoscale_block = {
+            "min_replicas": 1,
+            "max_replicas": max_replicas,
+            "final_replicas": len(router._members()),
+            "scale_ups": counters.get("scaler.scale_ups", 0),
+            "scale_downs": counters.get("scaler.scale_downs", 0),
+            "spawn_failures": counters.get("scaler.spawn_failures", 0),
+            "lost_requests": lost,  # MUST be 0
+            "replica_trajectory": [
+                {k: point[k] for k in ("t_s", "replicas", "hint", "action")}
+                for point in scaler.history
+            ],
+            "phase_burn": {
+                name: {
+                    "ticks": len(points),
+                    "mean_replicas": (
+                        round(
+                            sum(p["replicas"] for p in points) / len(points),
+                            2,
+                        ) if points else None
+                    ),
+                    "max_burn_fast": max(
+                        (p["burn_rate_fast"] or 0.0 for p in points),
+                        default=None,
+                    ),
+                }
+                for name, points in phases.items()
+            },
+        }
     print(
         json.dumps(
             {
-                "metric": "serve_router_microbench",
+                "metric": (
+                    "serve_autoscale_microbench" if autoscale
+                    else "serve_router_microbench"
+                ),
                 "value": report["achieved_rps"],
                 "unit": "requests/sec",
                 "vs_baseline": 0.0,  # no router baseline exists (BASELINE.md)
@@ -1108,6 +1218,7 @@ def _run_serve_router_micro(
                 },
                 "router": record.get("router", {}),
                 "slo": record.get("slo", {}),
+                "autoscale": autoscale_block,
                 "config": {
                     "model": os.environ.get("BENCH_MODEL", "base"),
                     "seq_len": seq_len,

@@ -604,7 +604,7 @@ def serve_from_archive(
         "replica fleet: %d service(s) over %d local device(s)",
         n_replicas, len(devices),
     )
-    return _with_slo_monitor(_with_drift_monitor(ReplicaRouter(
+    target = _with_slo_monitor(_with_drift_monitor(ReplicaRouter(
         replica_list,
         config=RouterConfig(
             heartbeat_timeout_s=float(serve_cfg["heartbeat_timeout_s"]),
@@ -614,6 +614,45 @@ def serve_from_archive(
         ),
         retry_policy=retry_policy,
     )))
+    if bool(serve_cfg["autoscale_enabled"]):
+        # close the scale_hint loop (serving/autoscaler.py): the
+        # controller spawns replicas through the SAME make_factory path
+        # a restart takes, so a scale-up is AOT-warmed before admission.
+        # Attached as an attribute (like slo_monitor) so the CLI stops
+        # it at drain and /healthz carries its status block.
+        slo_monitor = getattr(target, "slo_monitor", None)
+        if slo_monitor is None:
+            raise ValueError(
+                "serving.autoscale_enabled requires serving.slo_enabled "
+                "(the scale_hint comes from the SLO monitor)"
+            )
+        from .serving.autoscaler import Autoscaler, AutoscalerConfig
+
+        target.autoscaler = Autoscaler(
+            target,
+            replica_factory=make_factory,
+            slo_monitor=slo_monitor,
+            config=AutoscalerConfig(
+                min_replicas=int(serve_cfg["autoscale_min_replicas"]),
+                max_replicas=int(serve_cfg["autoscale_max_replicas"]),
+                interval_s=float(serve_cfg["autoscale_interval_s"]),
+                up_cooldown_s=float(serve_cfg["autoscale_up_cooldown_s"]),
+                down_cooldown_s=float(
+                    serve_cfg["autoscale_down_cooldown_s"]
+                ),
+                up_consecutive=int(serve_cfg["autoscale_up_consecutive"]),
+                down_consecutive=int(
+                    serve_cfg["autoscale_down_consecutive"]
+                ),
+                drain_timeout_s=float(
+                    serve_cfg["autoscale_drain_timeout_s"]
+                ),
+            ),
+            registry=telemetry.get_registry(),
+            retry_policy=retry_policy,
+            run_dir=out_dir,
+        )
+    return target
 
 
 def score_corpus_from_archive(

@@ -380,6 +380,27 @@ SERVING_DEFAULTS: Dict[str, Any] = {
     "slo_fast_window_s": 60.0,   # spike-catcher burn window
     "slo_window_s": 300.0,       # confirmation (slow) burn window
     "slo_interval_s": 5.0,       # sampling cadence
+    # cross-host fleet (serving/fleet.py; docs/serving.md "Cross-host
+    # fleet"): ``serve --hosts`` puts a HostBalancer over per-host
+    # router fleets; the knobs below are its stall/restart policy
+    "hosts": None,                    # "host[:port],..." or None (single host)
+    "fleet_heartbeat_timeout_s": 10.0,  # host stall-eviction threshold
+    "fleet_monitor_interval_s": 0.25,   # balancer health-check cadence
+    "fleet_max_reroutes": 2,          # cross-host re-enqueue attempts
+    "fleet_max_restarts": 2,          # per-host budget, then quarantine
+    # autoscaler (serving/autoscaler.py; docs/serving.md "Autoscaling"):
+    # consumes the SLO monitor's scale_hint and grows/shrinks the local
+    # replica count live, inside [min, max], with per-direction
+    # cooldowns and consecutive-tick hysteresis
+    "autoscale_enabled": False,
+    "autoscale_min_replicas": 1,
+    "autoscale_max_replicas": 4,
+    "autoscale_interval_s": 1.0,      # hint-sampling cadence
+    "autoscale_up_cooldown_s": 5.0,
+    "autoscale_down_cooldown_s": 30.0,
+    "autoscale_up_consecutive": 2,    # agreeing "up" ticks before acting
+    "autoscale_down_consecutive": 4,  # agreeing "down" ticks before acting
+    "autoscale_drain_timeout_s": 10.0,  # retire: in-flight completion bound
 }
 
 

@@ -35,6 +35,20 @@ point              fires
                    ``shard.stall.shard-<i>`` targets one shard
 ``merge.verify``   at merge-phase entry, before the exactly-once
                    verification pass (distributed/coordinator.py)
+``host.kill``      once per request routed to a fleet host, on its
+                   submit path (serving/fleet.py) — firing it
+                   hard-kills that host with SIGKILL semantics
+                   (nothing resolves; the balancer must sweep +
+                   re-route); ``host.kill.host-<i>`` targets one host
+``host.stall``     same site — the host wedges (alive, accepting, no
+                   progress: submitted futures park unresolved and the
+                   heartbeat freezes) so the balancer's heartbeat-age
+                   stall detector is what must catch it;
+                   ``host.stall.host-<i>`` targets one host
+``scaler.spawn``   once per autoscaler scale-up, before the replica
+                   factory runs (serving/autoscaler.py) — a firing is
+                   a failed spawn the scaler must retry through its
+                   RetryPolicy and then refuse machine-readably
 =================  ==========================================================
 
 With no configuration every point is a near-zero-cost no-op.  Arming is
@@ -91,9 +105,13 @@ REGISTERED_POINTS = frozenset({
     "shard.kill",
     "shard.stall",
     "merge.verify",
+    "host.kill",
+    "host.stall",
+    "scaler.spawn",
 })
 REGISTERED_POINT_PREFIXES = (
     "step.", "replica.kill.", "shard.kill.", "shard.stall.",
+    "host.kill.", "host.stall.",
 )
 
 _lock = threading.Lock()

@@ -8,7 +8,10 @@ Entry points: ``build.serve_from_archive`` constructs a ready
 :class:`ScoringService` (or, with ``serving.replicas > 1``, a
 :class:`ReplicaRouter` over N of them); ``python -m memvul_tpu serve
 [--replicas N]`` puts the stdlib HTTP front end (serving/frontend.py)
-on top of either.
+on top of either.  Above the single host: ``serve --hosts`` fronts a
+:class:`HostBalancer` over per-host fleets (serving/fleet.py), and
+``serving.autoscale_enabled`` closes the ``scale_hint`` loop with a
+live :class:`Autoscaler` (serving/autoscaler.py).
 """
 
 from .service import (  # noqa: F401
@@ -32,6 +35,15 @@ from .replica import (  # noqa: F401
     ReplicaDead,
 )
 from .router import ReplicaRouter, RouterConfig, rolling_swap  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetConfig,
+    HostBalancer,
+    HostDead,
+    LocalHost,
+    ProcessHost,
+    enumerate_hosts,
+)
+from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
 from .loadgen import (  # noqa: F401
     LoadConfig,
     LoadGenerator,

@@ -1,0 +1,385 @@
+"""Live replica autoscaling — the consumer of the SLO monitor's
+``scale_hint``.
+
+PR 10 published a machine-readable autoscaling signal (serving/slo.py:
+``"up"`` on budget burn / backlog / shedding / latency breach,
+``"down"`` only when both burn windows are quiet and the fleet is
+underfilled) and nothing consumed it.  :class:`Autoscaler` closes the
+loop: it watches the hint and grows or shrinks a live
+:class:`~memvul_tpu.serving.router.ReplicaRouter`'s replica count
+without dropping a single request.
+
+* **scale-up** — spawn replica → AOT-warm → admit: a worker thread
+  builds a fresh :class:`~memvul_tpu.serving.replica.Replica` through
+  the same service-factory path the router's restart recovery uses
+  (``build.serve_from_archive``'s per-device factory; encode + AOT
+  warmup happen inside the factory, exactly like a restart), syncs the
+  fleet's current anchor bank (``router._sync_bank`` — a spawn
+  mid-rollout cannot resurrect an old bank), then admits it via
+  :meth:`ReplicaRouter.admit_replica`.  A failed spawn is retried
+  through the shared :class:`~memvul_tpu.resilience.retry.RetryPolicy`
+  and then **refused** with a machine-readable record
+  (``scaler.spawn_failures`` + the ``last_refusal`` status field) —
+  the fleet keeps serving at its current size.
+* **scale-down** — stop-route → drain in-flight → retire: the victim's
+  readmission gate closes (``accepting``), the worker waits for its
+  private queue to empty, then removes it from routing
+  (:meth:`ReplicaRouter.retire_replica` re-enqueues anything still
+  charged to it) and retires it (:meth:`Replica.retire`).  No request
+  is ever lost to a retirement: the per-cause counter invariant
+  ``served + shed + errors == requests`` is checked over retired
+  members too.
+* **stability** — min/max bounds, per-direction cooldowns, and
+  hysteresis (``up_consecutive``/``down_consecutive`` agreeing ticks)
+  so burn-rate flapping cannot thrash the fleet; one scale operation
+  in flight at a time.
+
+The class itself only *decides*: reading ``status()`` dicts, counting
+streaks, and spawning a worker thread.  Every heavy operation (factory
+build, bank install, drain waits) lives in the module-level workers —
+the same split the router's monitor/``_recover_replica`` uses, enforced
+by checker MV102 for ``*Autoscaler`` classes
+(tools/lint_no_blocking_in_handler.py).
+
+Metrics (``scaler.*``, docs/observability.md): ``scaler.replicas``
+gauge, ``scaler.scale_events`` / ``scaler.scale_ups`` /
+``scaler.scale_downs`` / ``scaler.spawn_failures`` counters, and a
+``scaler.hint`` gauge mirroring the hint the last tick acted on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..resilience import faults
+from ..telemetry import get_registry
+from .replica import Replica
+from .router import ReplicaRouter, _sync_bank
+from .slo import SCALE_DOWN, SCALE_HOLD, SCALE_UP, _HINT_GAUGE
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Bounds + stability knobs; the ``autoscale_*`` keys of
+    ``config.SERVING_DEFAULTS`` are the JSON-facing view."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    interval_s: float = 1.0        # hint-sampling cadence
+    up_cooldown_s: float = 5.0     # quiet time after a scale-up (or refusal)
+    down_cooldown_s: float = 30.0  # quiet time after a scale-down
+    up_consecutive: int = 2        # agreeing "up" ticks before acting
+    down_consecutive: int = 4      # agreeing "down" ticks before acting
+    drain_timeout_s: float = 10.0  # retire: in-flight completion bound
+    history: int = 512             # replica-trajectory ring (bench record)
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "max_replicas must be >= min_replicas "
+                f"({self.max_replicas} < {self.min_replicas})"
+            )
+        if self.up_consecutive < 1 or self.down_consecutive < 1:
+            raise ValueError("hysteresis streaks must be >= 1")
+
+
+class Autoscaler:
+    """Grow/shrink a router's replica count from the SLO scale_hint.
+
+    ``replica_factory(index)`` must return a *service factory* (the
+    ``registry -> ScoringService`` closure a :class:`Replica` is built
+    over) — ``build.serve_from_archive`` passes its per-device
+    ``make_factory``, so a spawned replica takes the identical
+    placement/warmup path as a restarted one.  ``slo_monitor`` is the
+    hint source (its own thread keeps ``status()`` fresh);
+    ``start=False`` skips the control thread so tests and the bench
+    drive :meth:`tick` deterministically."""
+
+    def __init__(
+        self,
+        router: ReplicaRouter,
+        replica_factory: Callable[[int], Callable],
+        slo_monitor,
+        config: Optional[AutoscalerConfig] = None,
+        registry=None,
+        retry_policy=None,
+        run_dir=None,
+        start: bool = True,
+    ) -> None:
+        self.router = router
+        self.replica_factory = replica_factory
+        self.slo_monitor = slo_monitor
+        self.config = config or AutoscalerConfig()
+        self.retry_policy = retry_policy
+        self.run_dir = run_dir
+        self._tel = registry if registry is not None else get_registry()
+        self._lock = threading.Lock()
+        self._scaling = False          # one scale operation in flight
+        self._streak_hint = SCALE_HOLD
+        self._streak = 0
+        self._last_up = -float("inf")   # monotonic stamps for cooldowns
+        self._last_down = -float("inf")
+        self._started = time.monotonic()
+        self._next_index = itertools.count(
+            max(r.index for r in router._members()) + 1
+        )
+        self.last_refusal: Optional[Dict[str, Any]] = None
+        self.history: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._tel.gauge("scaler.replicas").set(len(router._members()))
+        self._tel.event(
+            "scaler_start",
+            min=self.config.min_replicas, max=self.config.max_replicas,
+        )
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="memvul-autoscaler", daemon=True
+            )
+            self._thread.start()
+
+    # -- public surface --------------------------------------------------------
+
+    @property
+    def replicas(self) -> int:
+        return len(self.router._members())
+
+    def status(self) -> Dict[str, Any]:
+        """Machine-readable controller state — the ``autoscaler`` block
+        ``GET /healthz`` carries (a snapshot read)."""
+        now = time.monotonic()
+        cfg = self.config
+        with self._lock:
+            return {
+                "replicas": self.replicas,
+                "min_replicas": cfg.min_replicas,
+                "max_replicas": cfg.max_replicas,
+                "hint": self._streak_hint,
+                "streak": self._streak,
+                "scaling": self._scaling,
+                "cooldown_remaining_s": {
+                    "up": round(
+                        max(0.0, self._last_up + cfg.up_cooldown_s - now), 3
+                    ),
+                    "down": round(
+                        max(
+                            0.0, self._last_down + cfg.down_cooldown_s - now
+                        ), 3
+                    ),
+                },
+                "last_refusal": self.last_refusal,
+            }
+
+    def tick(self, now: Optional[float] = None, sync: bool = False) -> Optional[str]:
+        """One control decision: read the hint, update the hysteresis
+        streak, and — bounds, cooldowns, and streak permitting — start a
+        scale operation.  Returns the action taken (``"up"``/``"down"``)
+        or None.  ``now`` overrides the monotonic clock and ``sync``
+        runs the worker inline, both for deterministic tests."""
+        now = time.monotonic() if now is None else float(now)
+        hint = str(self.slo_monitor.status().get("scale_hint", SCALE_HOLD))
+        self._tel.gauge("scaler.hint").set(_HINT_GAUGE.get(hint, 0.0))
+        action = self._decide(hint, now)
+        self._observe(hint, action, now)
+        if action == SCALE_UP:
+            self._launch(_spawn_replica, sync)
+        elif action == SCALE_DOWN:
+            self._launch(_retire_replica, sync)
+        return action
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- decision --------------------------------------------------------------
+
+    def _decide(self, hint: str, now: float) -> Optional[str]:
+        """Pure policy: hysteresis streaks, per-direction cooldowns,
+        bounds, and the one-in-flight gate.  Selection only — nothing
+        here may block, score, or warm (the autoscaler lint)."""
+        cfg = self.config
+        with self._lock:
+            if hint != self._streak_hint:
+                self._streak_hint = hint
+                self._streak = 0
+            self._streak += 1
+            if self._scaling or hint == SCALE_HOLD:
+                return None
+            count = self.replicas
+            if hint == SCALE_UP:
+                if self._streak < cfg.up_consecutive:
+                    return None
+                if count >= cfg.max_replicas:
+                    return None
+                if now - self._last_up < cfg.up_cooldown_s:
+                    return None
+                self._last_up = now
+                self._scaling = True
+                return SCALE_UP
+            if hint == SCALE_DOWN:
+                if self._streak < cfg.down_consecutive:
+                    return None
+                if count <= cfg.min_replicas:
+                    return None
+                if now - self._last_down < cfg.down_cooldown_s:
+                    return None
+                self._last_down = now
+                self._scaling = True
+                return SCALE_DOWN
+            return None
+
+    def _observe(self, hint: str, action: Optional[str], now: float) -> None:
+        """Append one trajectory point (the bench record's
+        replica-count-vs-time curve) — bounded ring."""
+        slo = self.slo_monitor.status()
+        point = {
+            "t_s": round(now - self._started, 3),
+            "replicas": self.replicas,
+            "hint": hint,
+            "action": action,
+            "burn_rate_fast": slo.get("burn_rate_fast"),
+            "backlog": slo.get("backlog"),
+        }
+        with self._lock:
+            self.history.append(point)
+            if len(self.history) > self.config.history:
+                del self.history[: -self.config.history]
+
+    def _launch(self, worker, sync: bool) -> None:
+        """Hand the heavy work to a module-level worker — inline when a
+        test/bench asks for determinism, else its own thread (the same
+        per-incident split the router's monitor uses)."""
+        if sync:
+            worker(self)
+            return
+        threading.Thread(
+            target=worker, args=(self,),
+            name="memvul-autoscaler-worker", daemon=True,
+        ).start()
+
+    # -- worker ----------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.wait(max(0.05, self.config.interval_s)):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - the controller must
+                # outlive one bad sample (a replica dying mid-read)
+                logger.exception("autoscaler tick failed")
+
+
+def _spawn_replica(scaler: Autoscaler) -> None:
+    """Scale-up worker: build a fresh replica through the factory
+    (placement + anchor encode + AOT warmup — the identical path a
+    restart takes), sync the fleet's current bank, admit it.  A failure
+    burns the shared RetryPolicy's attempts and is then refused with a
+    machine-readable record; the fleet keeps serving at its current
+    size."""
+    tel = scaler._tel
+    router = scaler.router
+    index = next(scaler._next_index)
+    name = f"replica-{index}"
+    try:
+        def build() -> Replica:
+            # the scaler.spawn chaos point (docs/fault_tolerance.md):
+            # fires inside the retry window, like serve.batch
+            faults.fault_point("scaler.spawn")
+            return Replica(
+                index,
+                scaler.replica_factory(index),
+                run_dir=scaler.run_dir,
+            )
+
+        try:
+            if scaler.retry_policy is not None:
+                replica = scaler.retry_policy.call(
+                    build, description=f"spawn {name}"
+                )
+            else:
+                replica = build()
+        except Exception as e:  # noqa: BLE001 - any predictor/device
+            # failure must refuse the spawn, never crash the controller
+            refusal = {
+                "error": "spawn_failed",
+                "replica": name,
+                "attempts": (
+                    scaler.retry_policy.attempts
+                    if scaler.retry_policy is not None else 1
+                ),
+                "reason": f"{type(e).__name__}: {e}"[:200],
+            }
+            with scaler._lock:
+                scaler.last_refusal = refusal
+            tel.counter("scaler.spawn_failures").inc()
+            tel.event("scaler_spawn_refused", **refusal)
+            logger.error("spawn %s refused: %s", name, refusal["reason"])
+            return
+        _sync_bank(router, replica)
+        router.admit_replica(replica)
+        count = len(router._members())
+        tel.counter("scaler.scale_events").inc()
+        tel.counter("scaler.scale_ups").inc()
+        tel.gauge("scaler.replicas").set(count)
+        tel.event("scaler_scale_up", replica=replica.name, replicas=count)
+        logger.info("scaled up: %s admitted (%d replicas)", replica.name, count)
+    finally:
+        with scaler._lock:
+            scaler._scaling = False
+
+
+def _retire_replica(
+    scaler: Autoscaler, poll_interval_s: float = 0.01
+) -> None:
+    """Scale-down worker: stop-route → drain in-flight → retire.  The
+    victim is the newest healthy member (LIFO keeps the original fleet
+    stable); its gate closes first, the worker waits for its private
+    queue to empty (every in-flight request completes normally), then
+    membership is dropped (anything still charged re-enqueues onto
+    survivors) and the replica retires with its counters intact."""
+    tel = scaler._tel
+    router = scaler.router
+    cfg = scaler.config
+    try:
+        members = router._members()
+        if len(members) <= cfg.min_replicas:
+            return
+        victim = members[-1]
+        victim.accepting.clear()
+        tel.event("scaler_retire_begin", replica=victim.name)
+        deadline = time.monotonic() + cfg.drain_timeout_s
+        while time.monotonic() < deadline:
+            with router._lock:
+                owed = len(router._outstanding.get(victim.name, {}))
+            if owed == 0 and victim.queue_depth == 0:
+                break
+            time.sleep(poll_interval_s)
+        try:
+            router.retire_replica(victim)
+        except ValueError:
+            # raced a concurrent recovery/drain that already removed it
+            victim.accepting.set()
+            return
+        victim.retire(timeout=cfg.drain_timeout_s)
+        count = len(router._members())
+        tel.counter("scaler.scale_events").inc()
+        tel.counter("scaler.scale_downs").inc()
+        tel.gauge("scaler.replicas").set(count)
+        tel.event("scaler_scale_down", replica=victim.name, replicas=count)
+        logger.info(
+            "scaled down: %s retired (%d replicas)", victim.name, count
+        )
+    finally:
+        with scaler._lock:
+            scaler._scaling = False

@@ -21,7 +21,12 @@ table):
   from "healthy".  HTTP 200, or 503 once draining (a load balancer's
   eviction signal — that contract is unchanged).  When an
   :class:`~memvul_tpu.serving.slo.SLOMonitor` is attached the body
-  carries its ``slo`` block (attainment, burn rates, ``scale_hint``).
+  carries its ``slo`` block (attainment, burn rates, ``scale_hint``);
+  an attached :class:`~memvul_tpu.serving.autoscaler.Autoscaler`
+  contributes an ``autoscaler`` block (replica count, hint streak,
+  cooldowns, last spawn refusal), and behind a
+  :class:`~memvul_tpu.serving.fleet.HostBalancer` the summary is the
+  merged per-host view with the quarantined hosts named.
 * ``GET /metrics`` → the live registries in Prometheus text format
   (telemetry/exposition.py; a router fans out per-replica parts with
   ``replica`` labels).
@@ -144,6 +149,12 @@ class ScoreHandler(BaseHTTPRequestHandler):
             monitor = getattr(service, "slo_monitor", None)
             if monitor is not None:
                 summary["slo"] = monitor.status()
+            # same attachment pattern for the autoscaler: its status()
+            # (replica count, hint streak, cooldowns, last refusal) is
+            # a snapshot read too
+            scaler = getattr(service, "autoscaler", None)
+            if scaler is not None:
+                summary["autoscaler"] = scaler.status()
             self._reply(503 if summary["draining"] else 200, summary)
             return
         if path == "/metrics":

@@ -318,7 +318,32 @@ def run_slo_harness(
     report = LoadGenerator(target.submit, config).run(texts)
     record: Dict[str, Any] = {"load": report}
     if replicas is None:
-        replicas = getattr(target, "replicas", None)
+        hosts = getattr(target, "hosts", None)
+        if hosts is not None:
+            # cross-host target (serving/fleet.py): the invariant sums
+            # over every replica of every host, live and retired
+            replicas = target.members()
+            record["hosts"] = {
+                "total": len(hosts),
+                "alive": sum(1 for h in hosts if h.alive),
+                "members": [
+                    {
+                        "host": h.name,
+                        "state": h.state,
+                        "restarts": h.restart_count,
+                        "heartbeat_age_s": round(h.heartbeat_age_s(), 3),
+                    }
+                    for h in hosts
+                ],
+            }
+        else:
+            replicas = getattr(target, "replicas", None)
+            if replicas is not None:
+                # a scale-down retires members but their counters still
+                # belong in the invariant: every request ever admitted
+                replicas = list(replicas) + list(
+                    getattr(target, "retired_replicas", ())
+                )
     if replicas:
         record["fleet"] = fleet_snapshot(replicas)
     registry = router_registry or getattr(target, "_tel", None)
@@ -329,6 +354,16 @@ def run_slo_harness(
             for name, value in counters.items()
             if name.startswith("router.")
         }
+        balancer = {
+            name.split(".", 1)[1]: value
+            for name, value in counters.items()
+            if name.startswith("fleet.")
+        }
+        if balancer:
+            record.setdefault("hosts", {})["counters"] = balancer
+    scaler = getattr(target, "autoscaler", None)
+    if scaler is not None:
+        record["autoscaler"] = scaler.status()
     monitor = slo_monitor or getattr(target, "slo_monitor", None)
     if monitor is not None:
         monitor.tick()

@@ -56,6 +56,8 @@ REPLICA_HEALTHY = "healthy"
 REPLICA_UNHEALTHY = "unhealthy"
 REPLICA_SWAPPING = "swapping"   # readmission-gated during a rolling swap
 REPLICA_DEAD = "dead"
+REPLICA_RETIRED = "retired"     # scale-down terminal: drained + closed,
+                                # counters kept for the fleet invariant
 
 
 class ReplicaDead(RuntimeError):
@@ -297,6 +299,28 @@ class Replica:
         )
 
     # -- shutdown --------------------------------------------------------------
+
+    def retire(self, timeout: float = 30.0) -> None:
+        """Scale-down terminal state (serving/autoscaler.py): the caller
+        has already stopped routing (``accepting`` cleared) and waited
+        for the private queue to empty, so the drain here is normally
+        instant — anything unexpectedly still queued resolves
+        ``"drain"`` and flows back through the router's re-enqueue
+        rather than being lost.  The registry closes but keeps its
+        counters readable: the fleet invariant is checked over retired
+        members too (``ReplicaRouter.retired_replicas``)."""
+        self.accepting.clear()
+        if self.state != REPLICA_DEAD:
+            self.service.drain(timeout=timeout)
+        else:
+            # a retire that raced a death still accounts the casualties
+            self.sweep_unresolved()
+        with self._state_lock:
+            self.state = REPLICA_RETIRED
+        self.registry.counter("replica.retires").inc()
+        self.registry.event("replica_retired", replica=self.name)
+        self.registry.close()
+        logger.info("%s retired", self.name)
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain the service (unless already dead) and close this

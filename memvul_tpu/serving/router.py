@@ -113,6 +113,10 @@ class ReplicaRouter:
         if not replicas:
             raise ValueError("a router needs at least one replica")
         self.replicas: List[Replica] = list(replicas)
+        # scale-down keeps retired members here: their registries (and
+        # therefore their counters) survive, so the fleet-wide counter
+        # invariant still sums over every request ever admitted
+        self.retired_replicas: List[Replica] = []
         self.config = config or RouterConfig()
         self.retry_policy = retry_policy
         self._tel = registry if registry is not None else get_registry()
@@ -132,6 +136,7 @@ class ReplicaRouter:
         self._bank_instances: Optional[List[Dict]] = None
         self._bank_source: str = "rolling_swap"
         self._bank_store_version: Optional[str] = None
+        self._shadow_tap = None  # re-attached onto autoscaler-spawned members
         self._default_deadline_ms = self.replicas[0].service.default_deadline_ms
         self._recovering: Dict[str, bool] = {}
         self._monitor = threading.Thread(
@@ -144,13 +149,21 @@ class ReplicaRouter:
 
     # -- ScoringService-compatible surface ------------------------------------
 
+    def _members(self) -> List[Replica]:
+        """A point-in-time copy of the live membership — every iteration
+        uses this so the autoscaler's admit/retire (which mutate
+        ``self.replicas`` under the lock) can never corrupt a reader
+        mid-walk."""
+        with self._lock:
+            return list(self.replicas)
+
     @property
     def draining(self) -> bool:
         return self._draining.is_set()
 
     @property
     def queue_depth(self) -> int:
-        return sum(r.queue_depth for r in self.replicas)
+        return sum(r.queue_depth for r in self._members())
 
     @property
     def bank_version(self) -> int:
@@ -165,11 +178,13 @@ class ReplicaRouter:
     def set_shadow_tap(self, tap) -> None:
         """Fan one shadow tap out to every replica (each replica
         re-attaches it across its own restarts)."""
-        for replica in self.replicas:
+        self._shadow_tap = tap
+        for replica in self._members():
             replica.set_shadow_tap(tap)
 
     def clear_shadow_tap(self) -> None:
-        for replica in self.replicas:
+        self._shadow_tap = None
+        for replica in self._members():
             replica.clear_shadow_tap()
 
     def health_summary(self) -> Dict[str, Any]:
@@ -178,7 +193,7 @@ class ReplicaRouter:
         external probe can tell "degraded fleet" (some unhealthy
         members) from "healthy"."""
         draining = self._draining.is_set()
-        members = [r.summary() for r in self.replicas]
+        members = [r.summary() for r in self._members()]
         healthy = sum(1 for m in members if m["state"] == REPLICA_HEALTHY)
         if draining:
             status = "draining"
@@ -210,7 +225,7 @@ class ReplicaRouter:
         way the on-disk ``replica-<i>/`` sinks do.  Registry reads only
         (the handler/router lint's snapshot discipline)."""
         parts: List = [({}, self._tel.snapshot())]
-        for replica in self.replicas:
+        for replica in self._members():
             parts.append(({"replica": replica.name}, replica.registry.snapshot()))
             service = replica.service
             if service is not None:
@@ -225,7 +240,7 @@ class ReplicaRouter:
         stamped with their replica name, merged newest-compile-first
         (the per-row ``compiled_wall`` orders them globally)."""
         rows: List[Dict[str, Any]] = []
-        for replica in self.replicas:
+        for replica in self._members():
             service = replica.service
             if service is None:
                 continue
@@ -241,7 +256,7 @@ class ReplicaRouter:
         merged newest-first (one in-process monotonic clock orders them
         globally)."""
         records: List[Dict[str, Any]] = []
-        for replica in self.replicas:
+        for replica in self._members():
             records.extend(replica.service.recent_traces())
         records.sort(
             key=lambda r: -(r.get("waypoints", {}).get("resolved") or 0.0)
@@ -287,7 +302,7 @@ class ReplicaRouter:
         the smallest live queue, round-robin on ties.  Selection only;
         nothing here may block or score (the router lint)."""
         candidates = [
-            r for r in self.replicas
+            r for r in self._members()
             if r.state == REPLICA_HEALTHY and r.accepting.is_set()
         ]
         if not candidates:
@@ -312,7 +327,7 @@ class ReplicaRouter:
             })
             return
         with self._lock:
-            self._outstanding[replica.name][request.rid] = request
+            self._outstanding.setdefault(replica.name, {})[request.rid] = request
         try:
             # the router owns the journey id: a rerouted request keeps
             # its rid-derived trace id with a grown hop count, so the
@@ -324,7 +339,7 @@ class ReplicaRouter:
             )
         except ReplicaDead:
             with self._lock:
-                self._outstanding[replica.name].pop(request.rid, None)
+                self._outstanding.get(replica.name, {}).pop(request.rid, None)
             self._reroute(request, reason=f"{replica.name} died at submit")
             return
         self._tel.counter("router.routed").inc()
@@ -354,7 +369,7 @@ class ReplicaRouter:
         draining) is the replica's problem, not the client's — it
         re-routes instead of surfacing."""
         with self._lock:
-            self._outstanding[replica.name].pop(request.rid, None)
+            self._outstanding.get(replica.name, {}).pop(request.rid, None)
         status = response.get("status")
         if status == STATUS_DRAIN and not self._draining.is_set():
             self._reroute(request, reason=f"{replica.name} drained")
@@ -402,7 +417,7 @@ class ReplicaRouter:
     def _monitor_loop(self) -> None:
         cfg = self.config
         while not self._draining.wait(cfg.monitor_interval_s):
-            for replica in self.replicas:
+            for replica in self._members():
                 state = replica.check_health(
                     cfg.heartbeat_timeout_s, cfg.max_batch_errors
                 )
@@ -438,11 +453,56 @@ class ReplicaRouter:
         their callbacks; ``ScoreFuture``'s first-resolution-wins makes
         the race benign)."""
         with self._lock:
-            taken = self._outstanding[replica.name]
+            taken = self._outstanding.get(replica.name, {})
             self._outstanding[replica.name] = {}
         for request in taken.values():
             if not request.future.done():
                 self._reroute(request, reason=reason)
+
+    # -- live membership (serving/autoscaler.py) -------------------------------
+
+    def admit_replica(self, replica: Replica) -> None:
+        """Add a warmed replica to the routing set.  Membership
+        bookkeeping only — the heavy spawn work (factory build, AOT
+        warmup, bank sync) already happened on the autoscaler's worker
+        thread; nothing here may block (the router lint)."""
+        if self._draining.is_set():
+            raise RuntimeError("cannot admit a replica into a draining fleet")
+        if self._shadow_tap is not None:
+            replica.set_shadow_tap(self._shadow_tap)
+        with self._lock:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(f"{replica.name} is already a member")
+            self.replicas.append(replica)
+            self._outstanding.setdefault(replica.name, {})
+            count = len(self.replicas)
+        self._tel.gauge("router.replicas").set(count)
+        self._tel.counter("router.replica_admits").inc()
+        self._tel.event("replica_admit", replica=replica.name, replicas=count)
+
+    def retire_replica(self, replica: Replica) -> None:
+        """Remove a drained replica from the routing set and re-enqueue
+        anything still charged to it (a retire must never lose a
+        request — the counter invariant is checked over
+        ``retired_replicas`` too).  The caller owns stopping routes and
+        draining first (serving/autoscaler.py); this is membership
+        bookkeeping only."""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                raise ValueError("cannot retire the last replica")
+            try:
+                self.replicas.remove(replica)
+            except ValueError:
+                raise ValueError(f"{replica.name} is not a member") from None
+            taken = self._outstanding.pop(replica.name, {})
+            self.retired_replicas.append(replica)
+            count = len(self.replicas)
+        for request in taken.values():
+            if not request.future.done():
+                self._reroute(request, reason=f"{replica.name} retired")
+        self._tel.gauge("router.replicas").set(count)
+        self._tel.counter("router.replica_retires").inc()
+        self._tel.event("replica_retire", replica=replica.name, replicas=count)
 
     # -- shutdown --------------------------------------------------------------
 
@@ -457,7 +517,7 @@ class ReplicaRouter:
         registries, resolve any stragglers.  Idempotent."""
         self.request_drain()
         self._monitor.join(timeout)
-        for replica in self.replicas:
+        for replica in self._members():
             replica.close(timeout=timeout or 30.0)
         with self._lock:
             leftovers = [
@@ -520,21 +580,9 @@ def _recover_replica(router: ReplicaRouter, replica: Replica, dead: bool) -> Non
             logger.error("%s restart failed: %s", replica.name, e)
             return
         # the rebuilt service carries the factory-built bank; sync it to
-        # the fleet's current rollout BEFORE readmission, under the swap
-        # lock so this install serializes with a concurrent rolling swap
-        # — a death mid-rollout cannot resurrect the old bank
-        with router._swap_lock:
-            if (
-                router._bank_instances is not None
-                and replica.bank_version != router._active_version
-            ):
-                replica.accepting.clear()
-                replica.install_bank(
-                    router._bank_instances, version=router._active_version,
-                    source=router._bank_source,
-                    store_version=router._bank_store_version,
-                )
-                replica.accepting.set()
+        # the fleet's current rollout BEFORE readmission — a death
+        # mid-rollout cannot resurrect the old bank
+        _sync_bank(router, replica)
         tel.counter("router.replica_restarts").inc()
         tel.event(
             "replica_restart", replica=replica.name, n=replica.restart_count
@@ -542,6 +590,27 @@ def _recover_replica(router: ReplicaRouter, replica: Replica, dead: bool) -> Non
     finally:
         with router._lock:
             router._recovering[replica.name] = False
+
+
+def _sync_bank(router: ReplicaRouter, replica: Replica) -> None:
+    """Install the fleet's current anchor bank on a freshly built
+    replica (a restart's rebuild, or an autoscaler spawn) before it is
+    (re)admitted.  Runs under the swap lock so the install serializes
+    with a concurrent rolling swap.  Control-plane code — encode + AOT
+    warmup happen inside ``install_bank``, which routing decisions may
+    never call (tools/lint_no_blocking_in_handler.py)."""
+    with router._swap_lock:
+        if (
+            router._bank_instances is not None
+            and replica.bank_version != router._active_version
+        ):
+            replica.accepting.clear()
+            replica.install_bank(
+                router._bank_instances, version=router._active_version,
+                source=router._bank_source,
+                store_version=router._bank_store_version,
+            )
+            replica.accepting.set()
 
 
 def rolling_swap(
@@ -579,7 +648,7 @@ def rolling_swap(
             "rolling_swap_start", version=target, replicas=len(router.replicas)
         )
         with tel.span("router.rolling_swap", version=target):
-            for replica in router.replicas:
+            for replica in router._members():
                 if replica.state == REPLICA_DEAD:
                     # the restart path re-installs the fleet bank before
                     # readmission (_recover_replica), so a dead member

@@ -584,6 +584,55 @@ def _cascade_block(
     }
 
 
+def _fleet_block(
+    counters: Dict[str, Any], gauges: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """The ``fleet`` block of the ``--json`` report (and the FLEET text
+    section): the cross-host balancer's request/supervision counters
+    plus the per-host heartbeat-age gauges its monitor republishes
+    (serving/fleet.py).  None when the run had no host balancer."""
+    fleet = {
+        k.split(".", 1)[1]: v for k, v in counters.items()
+        if k.startswith("fleet.")
+    }
+    heartbeat_ages = {
+        k.split("fleet.heartbeat_age_s.", 1)[1]: v
+        for k, v in gauges.items()
+        if k.startswith("fleet.heartbeat_age_s.")
+    }
+    hosts = gauges.get("fleet.hosts")
+    if not fleet and hosts is None:
+        return None
+    out: Dict[str, Any] = {
+        "hosts": hosts,
+        "hosts_alive": gauges.get("fleet.hosts_alive"),
+        "counters": fleet,
+    }
+    if heartbeat_ages:
+        out["heartbeat_age_s"] = heartbeat_ages
+    return out
+
+
+def _autoscaler_block(
+    counters: Dict[str, Any], gauges: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """The ``autoscaler`` block of the ``--json`` report (and the
+    AUTOSCALER text section): scale_hint actuation totals
+    (serving/autoscaler.py).  None when no autoscaler ran."""
+    scaler = {
+        k.split(".", 1)[1]: v for k, v in counters.items()
+        if k.startswith("scaler.")
+    }
+    replicas = gauges.get("scaler.replicas")
+    if not scaler and replicas is None:
+        return None
+    return {
+        "replicas": replicas,
+        "hint": gauges.get("scaler.hint"),
+        "counters": scaler,
+    }
+
+
 def report_json(
     run_dir: Union[str, Path], now: Optional[float] = None
 ) -> Dict[str, Any]:
@@ -593,7 +642,8 @@ def report_json(
     keys are pinned by tests (the ``lint --json`` pattern): ``schema``,
     ``run_dir``, ``events``, ``heartbeat``, ``spans``, ``counters``,
     ``gauges``, ``histograms``, ``derived``, ``latency_decomposition``,
-    ``cascade``, ``replicas``, ``shards``, ``programs``, ``roofline``."""
+    ``cascade``, ``fleet``, ``autoscaler``, ``replicas``, ``shards``,
+    ``programs``, ``roofline``."""
     data = load_run(run_dir)
     now = time.time() if now is None else now
     summary = data["summary"]
@@ -627,6 +677,10 @@ def report_json(
         "derived": _derived_metrics(counters),
         "latency_decomposition": _latency_decomposition(histograms),
         "cascade": _cascade_block(counters, programs["programs"]),
+        "fleet": _fleet_block(counters, dict(summary.get("gauges") or {})),
+        "autoscaler": _autoscaler_block(
+            counters, dict(summary.get("gauges") or {})
+        ),
         "replicas": _replica_rows(data["run_dir"], data["events"], now),
         "shards": _shard_rows(data["run_dir"], data["events"], now),
         "programs": programs["programs"],
@@ -847,6 +901,42 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
                 f"  device_time={_fmt_s(t['device_time_s'])}"
                 f"  share={t['device_time_share']:.1%}"
             )
+
+    # -- cross-host fleet (serving/fleet.py) -----------------------------------
+    fleet = _fleet_block(counters, gauges)
+    if fleet:
+        lines.append("")
+        lines.append("FLEET (cross-host balancer)")
+        lines.append(
+            f"  hosts: {_fmt_num(fleet.get('hosts', '?'))}"
+            f"  alive: {_fmt_num(fleet.get('hosts_alive', '?'))}"
+        )
+        fc = fleet["counters"]
+        if fc:
+            lines.append(
+                f"  requests: {_fmt_num(fc.get('requests', 0))}"
+                f"  served: {_fmt_num(fc.get('served', 0))}"
+                f"  reroutes: {_fmt_num(fc.get('reroutes', 0))}"
+                f"  host_deaths: {_fmt_num(fc.get('host_deaths', 0))}"
+                f"  restarts: {_fmt_num(fc.get('host_restarts', 0))}"
+                f"  quarantined: {_fmt_num(fc.get('quarantined', 0))}"
+            )
+        for host, age in sorted(fleet.get("heartbeat_age_s", {}).items()):
+            lines.append(f"  {host}: heartbeat_age={_fmt_s(age)}")
+
+    # -- autoscaler (serving/autoscaler.py) ------------------------------------
+    scaler = _autoscaler_block(counters, gauges)
+    if scaler:
+        lines.append("")
+        lines.append("AUTOSCALER (scale_hint actuation)")
+        sc = scaler["counters"]
+        lines.append(
+            f"  replicas: {_fmt_num(scaler.get('replicas', '?'))}"
+            f"  scale_events: {_fmt_num(sc.get('scale_events', 0))}"
+            f"  ups: {_fmt_num(sc.get('scale_ups', 0))}"
+            f"  downs: {_fmt_num(sc.get('scale_downs', 0))}"
+            f"  spawn_failures: {_fmt_num(sc.get('spawn_failures', 0))}"
+        )
 
     # -- replicas (scale-out serving runs) ------------------------------------
     replica_lines = _replica_section(data["run_dir"], events, now)

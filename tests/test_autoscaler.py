@@ -1,0 +1,421 @@
+"""Live autoscaling (serving/autoscaler.py, docs/serving.md
+"Autoscaling") — the consumer of PR 10's ``scale_hint``.
+
+The acceptance contract this file pins:
+
+* **policy** — hysteresis streaks, per-direction cooldowns, min/max
+  bounds, and the one-in-flight gate, all deterministic via
+  ``tick(now=..., sync=True)`` against a scripted hint source;
+* **scale-up** — a spawned replica takes the factory path (AOT warm in
+  ``__init__``), inherits the fleet's CURRENT bank, and serves;
+* **retire mid-burst** — a scale-down with requests in flight completes
+  EVERY one of them (stop-route → drain → retire), and the counter
+  invariant is exact over live + retired members;
+* **spawn failure** — a transient warmup failure is retried through the
+  shared RetryPolicy and admitted; a non-transient one is refused with
+  a machine-readable record while the fleet keeps serving;
+* **diurnal harness** — under a diurnal load with a scripted hint the
+  replica count tracks the hint (≥1 up and ≥1 down event), zero
+  requests hang, and the invariant holds;
+* **bench record** — ``BENCH_MICRO=serve`` + ``BENCH_SERVE_AUTOSCALE=1``
+  emits one parseable record with the replica trajectory, per-phase SLO
+  burn, and a zero lost-request count.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from memvul_tpu import telemetry
+from memvul_tpu.resilience import faults
+from memvul_tpu.resilience.retry import RetryPolicy
+from memvul_tpu.serving import (
+    STATUS_OK,
+    Autoscaler,
+    AutoscalerConfig,
+    LoadConfig,
+    ScoringService,
+    ServiceConfig,
+    rolling_swap,
+    run_slo_harness,
+)
+from memvul_tpu.serving.replica import REPLICA_RETIRED
+from memvul_tpu.telemetry.registry import TelemetryRegistry
+
+from test_serving_router import (
+    _FakePredictor,
+    assert_fleet_invariant,
+    fake_fleet,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+    telemetry.reset()
+
+
+class _ScriptedMonitor:
+    """A stand-in SLO monitor whose scale_hint is set by the test."""
+
+    def __init__(self, hint="hold"):
+        self.hint = hint
+
+    def status(self):
+        return {"scale_hint": self.hint, "burn_rate_fast": 0.0, "backlog": 0}
+
+
+def _service_factory(index):
+    """The replica_factory contract: index -> (registry -> service)."""
+
+    def factory(registry):
+        return ScoringService(
+            _FakePredictor(),
+            config=ServiceConfig(
+                max_batch=4, max_wait_ms=1.0, max_queue=1000,
+                default_deadline_ms=30000.0,
+            ),
+            registry=registry,
+        )
+
+    return factory
+
+
+def make_scaler(router, monitor, registry=None, retry_policy=None, **cfg_kw):
+    cfg_kw.setdefault("min_replicas", 1)
+    cfg_kw.setdefault("max_replicas", 3)
+    cfg_kw.setdefault("up_consecutive", 1)
+    cfg_kw.setdefault("down_consecutive", 1)
+    cfg_kw.setdefault("up_cooldown_s", 0.0)
+    cfg_kw.setdefault("down_cooldown_s", 0.0)
+    cfg_kw.setdefault("drain_timeout_s", 30.0)
+    return Autoscaler(
+        router,
+        replica_factory=_service_factory,
+        slo_monitor=monitor,
+        config=AutoscalerConfig(**cfg_kw),
+        registry=registry,
+        retry_policy=retry_policy,
+        start=False,
+    )
+
+
+# -- decision policy -----------------------------------------------------------
+
+def test_hysteresis_cooldowns_and_bounds():
+    router, replicas = fake_fleet(n=1, monitor_interval_s=3600.0)
+    monitor = _ScriptedMonitor("up")
+    scaler = make_scaler(
+        router, monitor,
+        up_consecutive=2, down_consecutive=2,
+        up_cooldown_s=10.0, down_cooldown_s=10.0,
+    )
+    base = time.monotonic()
+    try:
+        # hysteresis: one agreeing tick is not enough
+        assert scaler.tick(now=base, sync=True) is None
+        assert scaler.status()["streak"] == 1
+        assert scaler.tick(now=base + 0.1, sync=True) == "up"
+        assert scaler.replicas == 2
+        # cooldown: the streak is satisfied but the window is not
+        assert scaler.tick(now=base + 0.2, sync=True) is None
+        assert scaler.status()["cooldown_remaining_s"]["up"] > 0
+        assert scaler.tick(now=base + 11.0, sync=True) == "up"
+        assert scaler.replicas == 3
+        # bound: at max_replicas the hint is ignored
+        assert scaler.tick(now=base + 22.0, sync=True) is None
+        assert scaler.replicas == 3
+        # direction flip resets the streak
+        monitor.hint = "down"
+        assert scaler.tick(now=base + 22.1, sync=True) is None
+        assert scaler.status()["streak"] == 1
+        assert scaler.tick(now=base + 22.2, sync=True) == "down"
+        assert scaler.replicas == 2
+        assert scaler.tick(now=base + 33.0, sync=True) == "down"
+        assert scaler.replicas == 1
+        # bound: at min_replicas the hint is ignored
+        assert scaler.tick(now=base + 44.0, sync=True) is None
+        assert scaler.replicas == 1
+        # hold never acts
+        monitor.hint = "hold"
+        assert scaler.tick(now=base + 55.0, sync=True) is None
+    finally:
+        router.drain()
+
+
+def test_hint_flap_resets_streak():
+    router, _ = fake_fleet(n=1, monitor_interval_s=3600.0)
+    monitor = _ScriptedMonitor("up")
+    scaler = make_scaler(router, monitor, up_consecutive=3)
+    try:
+        assert scaler.tick(now=0.0, sync=True) is None
+        assert scaler.tick(now=0.1, sync=True) is None
+        monitor.hint = "hold"  # the flap
+        assert scaler.tick(now=0.2, sync=True) is None
+        monitor.hint = "up"
+        assert scaler.tick(now=0.3, sync=True) is None  # streak restarted
+        assert scaler.replicas == 1
+        history = scaler.history
+        assert [p["hint"] for p in history] == ["up", "up", "hold", "up"]
+        assert all(p["action"] is None for p in history)
+    finally:
+        router.drain()
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscalerConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscalerConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="streaks"):
+        AutoscalerConfig(up_consecutive=0)
+
+
+# -- scale-up ------------------------------------------------------------------
+
+def test_scale_up_spawned_replica_serves_current_bank():
+    """A replica spawned AFTER a rolling swap must come up on the
+    fleet's CURRENT bank (v2), not its factory-built one — the same
+    `_sync_bank` discipline as restart recovery."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, replicas = fake_fleet(n=1, monitor_interval_s=3600.0)
+        new_bank = [
+            {"text1": f"s{i}", "meta": {"label": f"S#{i}"}} for i in range(3)
+        ]
+        assert rolling_swap(router, new_bank, drain_timeout_s=10.0) == 2
+        monitor = _ScriptedMonitor("up")
+        scaler = make_scaler(router, monitor, registry=registry)
+        assert scaler.tick(now=1.0, sync=True) == "up"
+        assert scaler.replicas == 2
+        spawned = router.replicas[-1]
+        assert spawned.name == "replica-1"
+        assert spawned.bank_version == 2
+        # the spawned replica actually serves
+        served_by = set()
+        for i in range(16):
+            response = router.submit(f"r {i}").result(timeout=15)
+            assert response["status"] == STATUS_OK
+            assert response["bank_version"] == 2
+            served_by.add(response["replica"])
+        assert "replica-1" in served_by
+        counters = registry.snapshot()["counters"]
+        assert counters.get("scaler.scale_ups") == 1
+        assert counters.get("scaler.scale_events") == 1
+        assert registry.snapshot()["gauges"].get("scaler.replicas") == 2.0
+        router.drain()
+        assert_fleet_invariant(router.replicas)
+    finally:
+        telemetry.reset()
+
+
+# -- retire mid-burst ----------------------------------------------------------
+
+def test_retire_mid_burst_completes_every_inflight_request():
+    """The scale-down acceptance gate: a retirement issued while the
+    victim has queued + in-flight work completes EVERY request (gate
+    closes, drain waits, THEN retire), and the invariant is exact over
+    live + retired members."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, replicas = fake_fleet(n=2, monitor_interval_s=3600.0)
+        hold = threading.Event()
+        victim = replicas[-1]  # the scaler retires the newest member
+        victim.service.predictor.hold = hold
+        futures = [
+            router.submit(f"burst {i}", deadline_ms=0) for i in range(12)
+        ]
+        time.sleep(0.05)  # let the victim's batcher pull and block
+        assert victim.queue_depth > 0 or any(
+            not f.done() for f in futures
+        )
+        monitor = _ScriptedMonitor("down")
+        scaler = make_scaler(router, monitor, registry=registry)
+        # release the wedge shortly after the retire begins — the drain
+        # wait must see the in-flight work COMPLETE, not abandon it
+        threading.Timer(0.2, hold.set).start()
+        assert scaler.tick(now=1.0, sync=True) == "down"
+        # every in-flight request resolved OK — nothing was lost
+        responses = [f.result(timeout=15) for f in futures]
+        assert all(r["status"] == STATUS_OK for r in responses), responses
+        assert scaler.replicas == 1
+        assert victim.state == REPLICA_RETIRED
+        assert list(router.retired_replicas) == [victim]
+        counters = registry.snapshot()["counters"]
+        assert counters.get("scaler.scale_downs") == 1
+        # the invariant sums over live + retired members, exactly
+        snap = assert_fleet_invariant(
+            list(router.replicas) + list(router.retired_replicas)
+        )
+        assert snap["served_total"] == 12
+        # the shrunk fleet keeps serving
+        response = router.submit("after retire").result(timeout=15)
+        assert response["status"] == STATUS_OK
+        assert response["replica"] == "replica-0"
+        router.drain()
+    finally:
+        telemetry.reset()
+
+
+def test_retire_refuses_below_min_replicas():
+    router, _ = fake_fleet(n=1, monitor_interval_s=3600.0)
+    monitor = _ScriptedMonitor("down")
+    scaler = make_scaler(router, monitor, min_replicas=1)
+    try:
+        assert scaler.tick(now=1.0, sync=True) is None
+        assert scaler.replicas == 1
+    finally:
+        router.drain()
+
+
+# -- spawn failure: retried, then refused machine-readably ---------------------
+
+def test_spawn_transient_failure_retried_through_policy_then_admitted():
+    """A warmup failure with a transient marker (UNAVAILABLE) burns a
+    RetryPolicy attempt and succeeds on the retry — the fault clause
+    fires once and disarms, exactly the mid-chaos spawn shape."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, _ = fake_fleet(n=1, monitor_interval_s=3600.0)
+        monitor = _ScriptedMonitor("up")
+        scaler = make_scaler(
+            router, monitor, registry=registry,
+            retry_policy=RetryPolicy(attempts=3, backoff=0.01),
+        )
+        faults.configure("scaler.spawn=raise:RuntimeError:UNAVAILABLE injected")
+        assert scaler.tick(now=1.0, sync=True) == "up"
+        assert scaler.replicas == 2  # the retry bought the spawn back
+        assert scaler.last_refusal is None
+        counters = registry.snapshot()["counters"]
+        assert counters.get("scaler.spawn_failures", 0) == 0
+        assert counters.get("scaler.scale_ups") == 1
+        router.drain()
+    finally:
+        telemetry.reset()
+
+
+def test_spawn_nontransient_failure_refused_machine_readably():
+    """A genuine warmup bug is NOT retried: the spawn is refused with a
+    machine-readable record and the fleet keeps serving at its size."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, _ = fake_fleet(n=1, monitor_interval_s=3600.0)
+        monitor = _ScriptedMonitor("up")
+        scaler = make_scaler(
+            router, monitor, registry=registry,
+            retry_policy=RetryPolicy(attempts=3, backoff=0.01),
+        )
+        faults.configure("scaler.spawn=raise:RuntimeError:warmup exploded")
+        assert scaler.tick(now=1.0, sync=True) == "up"
+        assert scaler.replicas == 1  # nothing was admitted
+        refusal = scaler.last_refusal
+        assert refusal is not None
+        assert refusal["error"] == "spawn_failed"
+        assert refusal["replica"] == "replica-1"
+        assert "warmup exploded" in refusal["reason"]
+        assert scaler.status()["last_refusal"] == refusal
+        counters = registry.snapshot()["counters"]
+        assert counters.get("scaler.spawn_failures") == 1
+        assert counters.get("scaler.scale_ups", 0) == 0
+        # the controller is not wedged: the gate reopened
+        assert scaler.status()["scaling"] is False
+        # the fleet keeps serving
+        response = router.submit("still here").result(timeout=15)
+        assert response["status"] == STATUS_OK
+        router.drain()
+    finally:
+        telemetry.reset()
+
+
+# -- diurnal harness: the closed loop ------------------------------------------
+
+def test_diurnal_harness_replica_count_tracks_hint_no_lost_requests():
+    """Under a diurnal load with a scripted hint (up early, down late),
+    the closed loop records ≥1 scale-up and ≥1 scale-down, every
+    request resolves (zero hangs), and the invariant holds over live +
+    retired members."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        router, _ = fake_fleet(n=1, monitor_interval_s=3600.0)
+        monitor = _ScriptedMonitor("up")
+        scaler = make_scaler(
+            router, monitor, registry=registry,
+            max_replicas=3, up_cooldown_s=0.1, down_cooldown_s=0.05,
+            up_consecutive=1, down_consecutive=2,
+        )
+        router.autoscaler = scaler  # the harness folds status() in
+        stop = threading.Event()
+        t0 = time.monotonic()
+
+        def drive():
+            while not stop.wait(0.03):
+                monitor.hint = "up" if time.monotonic() - t0 < 0.35 else "down"
+                scaler.tick(sync=True)
+
+        driver = threading.Thread(target=drive, daemon=True)
+        driver.start()
+        try:
+            record = run_slo_harness(
+                router,
+                ["a short report", "a rather longer issue report text"],
+                config=LoadConfig(
+                    pattern="diurnal", requests=150, rps=150.0,
+                    diurnal_period_s=1.0, seed=7,
+                ),
+            )
+        finally:
+            stop.set()
+            driver.join(timeout=10)
+        router.drain()
+        assert record["load"]["outcomes"]["hang"] == 0
+        assert record["load"]["outcomes"]["ok"] > 0
+        actions = [p["action"] for p in scaler.history if p["action"]]
+        assert "up" in actions, scaler.history
+        assert "down" in actions, scaler.history
+        assert record["fleet"]["invariant_ok"]
+        assert record["autoscaler"]["replicas"] >= 1
+        counters = registry.snapshot()["counters"]
+        assert counters.get("scaler.scale_ups", 0) >= 1
+        assert counters.get("scaler.scale_downs", 0) >= 1
+        json.dumps(record)  # the whole record stays JSON-serializable
+    finally:
+        telemetry.reset()
+
+
+# -- bench record --------------------------------------------------------------
+
+def test_serve_autoscale_microbench_emits_parseable_record(monkeypatch, capsys):
+    """BENCH_MICRO=serve + BENCH_SERVE_AUTOSCALE=1 at tiny geometry: the
+    closed loop runs on CPU and lands one parseable record with the
+    replica trajectory, per-phase burn, and a ZERO lost-request count."""
+    from memvul_tpu import bench
+
+    monkeypatch.setenv("BENCH_MICRO", "serve")
+    monkeypatch.setenv("BENCH_MODEL", "tiny")
+    monkeypatch.setenv("BENCH_MICRO_REQUESTS", "48")
+    monkeypatch.setenv("BENCH_MICRO_CLIENTS", "4")
+    monkeypatch.setenv("BENCH_SERVE_REPLICAS", "2")
+    monkeypatch.setenv("BENCH_SERVE_AUTOSCALE", "1")
+    monkeypatch.setenv("BENCH_SERVE_MAX_BATCH", "4")
+    monkeypatch.setenv("BENCH_SEQ_LEN", "32")
+    monkeypatch.setenv("BENCH_PHASE_TIMEOUT", "0")
+    bench._run_bench()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    record = json.loads(line)
+    assert record["metric"] == "serve_autoscale_microbench"
+    assert record["value"] > 0
+    assert record["outcomes"]["hang"] == 0
+    assert record["config"]["pattern"] == "diurnal"
+    assert record["fleet"]["invariant_ok"] is True
+    block = record["autoscale"]
+    assert block["min_replicas"] == 1
+    assert block["max_replicas"] == 2
+    assert block["lost_requests"] == 0  # the must-always-be-zero number
+    assert block["final_replicas"] >= 1
+    assert isinstance(block["replica_trajectory"], list)
+    assert set(block["phase_burn"]) == {"rise", "peak", "fall", "trough"}
+    for phase in block["phase_burn"].values():
+        assert set(phase) == {"ticks", "mean_replicas", "max_burn_fast"}

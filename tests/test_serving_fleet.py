@@ -1,0 +1,473 @@
+"""Cross-host fleet supervision (serving/fleet.py, docs/serving.md
+"Cross-host fleet").
+
+The acceptance contract this file pins:
+
+* **routing + merged endpoints** — a 2-host balancer spreads load,
+  answers every request, and merges ``/healthz`` / ``/metrics`` /
+  ``/tracez`` / ``/programz`` with per-host labels;
+* **host death** — the ``host.kill`` fault point takes a whole host
+  down mid-load: every client still gets an answer (re-routed with its
+  ORIGINAL absolute deadline), the monitor restarts the host through
+  the shared RetryPolicy, and the cross-host counter invariant
+  ``Σ served + shed + errors == Σ requests`` stays exact over every
+  replica of every host, live and retired;
+* **host stall** — a wedged-alive host (``host.stall``) is caught only
+  by the heartbeat-age detector, killed, and its parked requests
+  re-routed onto survivors;
+* **quarantine** — a host out of restart budget is quarantined and a
+  request the fleet cannot place resolves a machine-readable refusal
+  naming the quarantined hosts;
+* **subprocess chaos** — a fresh interpreter SIGKILLs a host mid-load
+  (every replica dead, nothing resolves) and from the outside we assert
+  zero client hangs + the exact invariant (``@pytest.mark.slow``).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from memvul_tpu import telemetry
+from memvul_tpu.resilience import faults
+from memvul_tpu.resilience.retry import RetryPolicy
+from memvul_tpu.serving import (
+    STATUS_OK,
+    FleetConfig,
+    HostBalancer,
+    HostDead,
+    LocalHost,
+    ProcessHost,
+    Replica,
+    ReplicaRouter,
+    RouterConfig,
+    ScoringService,
+    ServiceConfig,
+    enumerate_hosts,
+    fleet_snapshot,
+)
+from memvul_tpu.serving.fleet import (
+    HOST_DEAD,
+    HOST_HEALTHY,
+    HOST_QUARANTINED,
+)
+
+from test_serving_router import _FakePredictor
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+    telemetry.reset()
+
+
+def _router_factory(n_replicas=1):
+    """A factory building a fresh fake-predictor router — the per-host
+    target, re-invoked on restart."""
+
+    def build():
+        def make_factory(i):
+            def factory(registry):
+                return ScoringService(
+                    _FakePredictor(),
+                    config=ServiceConfig(
+                        max_batch=4, max_wait_ms=1.0, max_queue=1000,
+                        default_deadline_ms=30000.0,
+                    ),
+                    registry=registry,
+                )
+            return factory
+
+        replicas = [
+            Replica(i, make_factory(i), telemetry_enabled=True)
+            for i in range(n_replicas)
+        ]
+        return ReplicaRouter(
+            replicas,
+            config=RouterConfig(monitor_interval_s=3600.0),
+        )
+
+    return build
+
+
+def local_fleet(n_hosts=2, n_replicas=1, registry=None, **config_kw):
+    config_kw.setdefault("monitor_interval_s", 0.05)
+    config_kw.setdefault("heartbeat_timeout_s", 60.0)
+    hosts = [
+        LocalHost(i, _router_factory(n_replicas)) for i in range(n_hosts)
+    ]
+    balancer = HostBalancer(
+        hosts,
+        config=FleetConfig(**config_kw),
+        registry=registry,
+        retry_policy=RetryPolicy(attempts=2, backoff=0.01),
+    )
+    return balancer, hosts
+
+
+def assert_cross_host_invariant(balancer):
+    """The cross-host leak detector: served + shed + errors == requests
+    summed over every replica of every host, live and retired."""
+    snap = fleet_snapshot(balancer.members())
+    assert snap["invariant_ok"], snap
+    return snap
+
+
+# -- enumeration ---------------------------------------------------------------
+
+def test_enumerate_hosts_spec_env_and_urls(monkeypatch):
+    assert enumerate_hosts("a,b:9000,http://c:8080/") == [
+        "http://a:8341", "http://b:9000", "http://c:8080",
+    ]
+    assert enumerate_hosts("a", default_port=9) == ["http://a:9"]
+    monkeypatch.setenv("MEMVUL_FLEET_HOSTS", "x:1, y:2")
+    assert enumerate_hosts() == ["http://x:1", "http://y:2"]
+    # an explicit spec beats the env
+    assert enumerate_hosts("z:3") == ["http://z:3"]
+    monkeypatch.delenv("MEMVUL_FLEET_HOSTS")
+    assert enumerate_hosts() == []
+    # pod-derived: {i}-template × multihost process count, but ONLY once
+    # the multihost runtime has actually been joined
+    from memvul_tpu.parallel import multihost
+
+    monkeypatch.setenv("MEMVUL_FLEET_HOST_TEMPLATE", "serve-{i}.svc:8343")
+    assert enumerate_hosts() == []  # runtime not initialized -> no probe
+    monkeypatch.setattr(multihost, "_initialized", True)
+    monkeypatch.setattr(multihost, "process_count", lambda: 3)
+    assert enumerate_hosts() == [
+        "http://serve-0.svc:8343",
+        "http://serve-1.svc:8343",
+        "http://serve-2.svc:8343",
+    ]
+    # the explicit env list still wins over the template
+    monkeypatch.setenv("MEMVUL_FLEET_HOSTS", "x:1")
+    assert enumerate_hosts() == ["http://x:1"]
+
+
+# -- routing + merged endpoints ------------------------------------------------
+
+def test_balancer_routes_and_stamps_host():
+    balancer, hosts = local_fleet(n_hosts=2)
+    try:
+        responses = [
+            balancer.submit(f"r {i}").result(timeout=15) for i in range(16)
+        ]
+        assert all(r["status"] == STATUS_OK for r in responses)
+        by_host = {r["host"] for r in responses}
+        assert by_host == {"host-0", "host-1"}  # the load spread
+        snap = assert_cross_host_invariant(balancer)
+        assert snap["served_total"] == 16
+    finally:
+        balancer.drain()
+
+
+def test_balancer_merged_healthz_metrics_traces_programs():
+    registry = telemetry.configure(enabled=True)
+    try:
+        balancer, hosts = local_fleet(n_hosts=2, registry=registry)
+        for i in range(8):
+            assert balancer.submit(f"r {i}").result(timeout=15)[
+                "status"
+            ] == STATUS_OK
+        health = balancer.health_summary()
+        assert health["status"] == "ok"
+        assert health["hosts"]["total"] == 2
+        assert health["hosts"]["alive"] == 2
+        assert health["hosts"]["quarantined"] == []
+        rows = {m["host"]: m for m in health["hosts"]["members"]}
+        assert set(rows) == {"host-0", "host-1"}
+        assert all("heartbeat_age_s" in m for m in rows.values())
+        assert all(m["target"]["status"] == "ok" for m in rows.values())
+        # /metrics: the balancer's own part plus host-labeled parts
+        parts = balancer.metrics_snapshots()
+        labels = [dict(lbl) for lbl, _ in parts]
+        assert {} in labels  # the fleet.* part, unlabeled
+        assert {"host": "host-0"} in [
+            {k: v for k, v in lbl.items() if k == "host"} for lbl in labels
+        ]
+        own = parts[0][1]["counters"]
+        assert own.get("fleet.requests") == 8
+        assert own.get("fleet.served") == 8
+        # /tracez + /programz merge across hosts without error
+        assert isinstance(balancer.recent_traces(limit=4), list)
+        programs = balancer.programs_snapshot()
+        assert all(row["host"] in {"host-0", "host-1"} for row in programs)
+        balancer.drain()
+    finally:
+        telemetry.reset()
+
+
+def test_balancer_drain_sheds_and_resolves():
+    balancer, _ = local_fleet(n_hosts=2)
+    balancer.drain()
+    response = balancer.submit("late").result(timeout=5)
+    assert response["status"] == "drain"
+
+
+# -- host death: kill fault, re-route, restart ---------------------------------
+
+@pytest.mark.chaos
+def test_host_kill_fault_reroutes_restarts_and_invariant_holds():
+    """The host.kill fault point takes host-0 down at submit: the
+    client's request re-routes to host-1 (original deadline), the
+    monitor restarts host-0 through the RetryPolicy, and the cross-host
+    invariant stays exact."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        balancer, hosts = local_fleet(n_hosts=2, registry=registry)
+        warm = [
+            balancer.submit(f"warm {i}").result(timeout=15) for i in range(8)
+        ]
+        assert all(r["status"] == STATUS_OK for r in warm)
+        faults.configure("host.kill.host-0=raise:RuntimeError:chaos kill")
+        responses = [
+            balancer.submit(f"post-kill {i}", deadline_ms=20000.0).result(
+                timeout=30
+            )
+            for i in range(24)
+        ]
+        assert all(r["status"] == STATUS_OK for r in responses), responses
+        rerouted = [r for r in responses if r.get("host_reroutes")]
+        assert rerouted, "the kill never forced a re-route"
+        assert all(r["host"] == "host-1" for r in rerouted)
+        # the monitor buys host-0 back
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and hosts[0].restart_count == 0:
+            time.sleep(0.02)
+        assert hosts[0].restart_count == 1
+        assert hosts[0].state == HOST_HEALTHY
+        counters = registry.snapshot()["counters"]
+        assert counters.get("fleet.host_deaths") == 1
+        assert counters.get("fleet.host_restarts") == 1
+        assert counters.get("fleet.reroutes", 0) >= len(rerouted)
+        # the restarted host serves again
+        deadline = time.monotonic() + 10
+        served_after = None
+        while time.monotonic() < deadline:
+            response = balancer.submit("after restart").result(timeout=15)
+            assert response["status"] == STATUS_OK
+            if response["host"] == "host-0":
+                served_after = response
+                break
+        assert served_after is not None, "restarted host never served"
+        balancer.drain()
+        assert_cross_host_invariant(balancer)
+    finally:
+        telemetry.reset()
+
+
+@pytest.mark.chaos
+def test_host_stall_caught_by_heartbeat_age_and_rerouted():
+    """A stalled host stays alive and accepting but makes no progress —
+    only the heartbeat-age detector can catch it.  Its parked request
+    re-routes onto the survivor with the original absolute deadline."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        balancer, hosts = local_fleet(
+            n_hosts=2, registry=registry,
+            heartbeat_timeout_s=0.2, monitor_interval_s=0.05,
+        )
+        warm = [
+            balancer.submit(f"warm {i}").result(timeout=15) for i in range(8)
+        ]
+        assert all(r["status"] == STATUS_OK for r in warm)
+        faults.configure("host.stall.host-0=raise:RuntimeError:wedge")
+        # drive until one submission lands on (and stalls) host-0
+        futures = [
+            balancer.submit(f"stall {i}", deadline_ms=20000.0)
+            for i in range(8)
+        ]
+        assert hosts[0]._stalled_at is not None
+        # every future resolves — the stalled host's parked work is
+        # reclaimed and re-routed, nothing hangs
+        responses = [f.result(timeout=30) for f in futures]
+        assert all(r["status"] == STATUS_OK for r in responses), responses
+        assert {r["host"] for r in responses} <= {"host-0", "host-1"}
+        rerouted = [r for r in responses if r.get("host_reroutes")]
+        assert rerouted, "the stall never forced a re-route"
+        counters = registry.snapshot()["counters"]
+        assert counters.get("fleet.host_deaths") == 1
+        balancer.drain()
+        assert_cross_host_invariant(balancer)
+    finally:
+        telemetry.reset()
+
+
+def test_quarantine_refusal_is_machine_readable():
+    """A host out of restart budget is quarantined; a request the fleet
+    cannot place resolves the PR 13-style refusal payload naming it."""
+    registry = telemetry.configure(enabled=True)
+    try:
+        balancer, hosts = local_fleet(
+            n_hosts=1, registry=registry,
+            auto_restart=False, monitor_interval_s=0.05,
+        )
+        assert balancer.submit("warm").result(timeout=15)[
+            "status"
+        ] == STATUS_OK
+        hosts[0].kill(reason="test")
+        deadline = time.monotonic() + 10
+        while (
+            time.monotonic() < deadline
+            and hosts[0].state != HOST_QUARANTINED
+        ):
+            time.sleep(0.02)
+        assert hosts[0].state == HOST_QUARANTINED
+        response = balancer.submit("nobody home").result(timeout=5)
+        assert response["status"] == "error"
+        refusal = response["refusal"]
+        assert refusal["error"] == "fleet_unavailable"
+        assert refusal["hosts_alive"] == 0
+        assert refusal["hosts_total"] == 1
+        assert refusal["quarantined"] == ["host-0"]
+        health = balancer.health_summary()
+        assert health["status"] == "unavailable"
+        assert health["hosts"]["quarantined"] == ["host-0"]
+        assert registry.snapshot()["counters"].get("fleet.quarantined") == 1
+        balancer.drain()
+    finally:
+        telemetry.reset()
+
+
+def test_dead_host_submit_raises_hostdead_directly():
+    balancer, hosts = local_fleet(n_hosts=2, auto_restart=False)
+    try:
+        hosts[0].kill(reason="test")
+        with pytest.raises(HostDead):
+            hosts[0].submit("direct")
+        assert hosts[0].state == HOST_DEAD
+        # through the balancer the dead host is simply never picked
+        response = balancer.submit("routed").result(timeout=15)
+        assert response["status"] == STATUS_OK
+        assert response["host"] == "host-1"
+    finally:
+        balancer.drain()
+
+
+# -- ProcessHost (fast: no real subprocess) ------------------------------------
+
+def test_process_host_attach_mode_and_unreachable_reroute():
+    """A url-attached ProcessHost whose endpoint is unreachable resolves
+    host_unreachable — and a balancer over it re-routes onto the live
+    LocalHost instead of failing the client."""
+    with pytest.raises(ValueError, match="exactly one"):
+        ProcessHost(0)
+    dead = ProcessHost(0, url="http://127.0.0.1:9/")  # discard port: refused
+    assert dead.base_url == "http://127.0.0.1:9"
+    response = dead.submit("hello").result(timeout=30)
+    assert response["status"] == "error"
+    assert response["reason"].startswith("host_unreachable")
+    with pytest.raises(HostDead, match="attach-only"):
+        dead.restart()
+    # balancer: the unreachable host's error re-routes to the survivor
+    live = LocalHost(1, _router_factory(1))
+    balancer = HostBalancer(
+        [ProcessHost(0, url="http://127.0.0.1:9"), live],
+        config=FleetConfig(monitor_interval_s=3600.0, max_reroutes=2),
+    )
+    try:
+        responses = [
+            balancer.submit(f"r {i}", deadline_ms=20000.0).result(timeout=30)
+            for i in range(8)
+        ]
+        assert all(r["status"] == STATUS_OK for r in responses), responses
+        assert all(r["host"] == "host-1" for r in responses)
+    finally:
+        balancer.drain()
+
+
+# -- subprocess chaos: whole-host SIGKILL semantics mid-load -------------------
+
+_CHAOS_DRIVER = """
+import json, threading, time
+
+import sys
+sys.path.insert(0, {test_dir!r})
+from test_serving_fleet import local_fleet, assert_cross_host_invariant
+
+from memvul_tpu.resilience import faults
+from memvul_tpu.serving import fleet_snapshot
+
+balancer, hosts = local_fleet(n_hosts=2, n_replicas=2, max_reroutes=3)
+for i in range(8):
+    assert balancer.submit(f"warm {{i}}").result(timeout=30)["status"] == "ok"
+faults.configure("host.kill.host-1=raise:RuntimeError:SIGKILL chaos")
+
+DEADLINE_MS = 15000.0
+overdue = []
+statuses = {{}}
+lock = threading.Lock()
+
+def client(k):
+    for i in range(k, 96, 8):
+        t0 = time.monotonic()
+        response = balancer.submit(
+            f"report {{i}}", deadline_ms=DEADLINE_MS
+        ).result(timeout=DEADLINE_MS / 1000.0 + 30.0)
+        waited = time.monotonic() - t0
+        with lock:
+            statuses[response["status"]] = statuses.get(response["status"], 0) + 1
+            if waited > DEADLINE_MS / 1000.0 + 5.0:
+                overdue.append(round(waited, 3))
+
+threads = [threading.Thread(target=client, args=(k,)) for k in range(8)]
+for t in threads: t.start()
+for t in threads: t.join()
+deadline = time.monotonic() + 20
+while time.monotonic() < deadline and hosts[1].restart_count == 0:
+    time.sleep(0.05)
+restarts = hosts[1].restart_count
+balancer.drain()
+snapshot = fleet_snapshot(balancer.members())
+print(json.dumps({{
+    "statuses": statuses,
+    "overdue": overdue,
+    "invariant_ok": snapshot["invariant_ok"],
+    "restarts": restarts,
+    "host1_state": hosts[1].state,
+    "replicas": snapshot["replicas"],
+}}))
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_subprocess_host_sigkill_mid_load_invariant_and_no_hang(tmp_path):
+    """Acceptance gate: a fresh interpreter runs a 2-host fleet, the
+    host.kill fault point SIGKILLs host-1 mid-load (every replica dead,
+    unresolved work swept to errors), and from the outside we assert
+    zero client hangs, re-routes under the ORIGINAL deadlines, and the
+    exact cross-host invariant."""
+    driver = tmp_path / "fleet_chaos_driver.py"
+    driver.write_text(_CHAOS_DRIVER.format(
+        test_dir=str(Path(__file__).resolve().parent)
+    ))
+    proc = subprocess.run(
+        [sys.executable, str(driver)],
+        capture_output=True, text=True, timeout=300,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": __import__("os").pathsep.join(sys.path),
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    # every client resolved, none past its deadline window
+    assert record["overdue"] == []
+    assert sum(record["statuses"].values()) == 96
+    assert record["statuses"].get("ok", 0) > 0
+    # the host died and came back (or was quarantined if restarts failed)
+    assert record["restarts"] >= 1 or record["host1_state"] == "quarantined"
+    # the cross-host exact-counter invariant survived whole-host death
+    assert record["invariant_ok"], record["replicas"]
+    for member in record["replicas"]:
+        assert (
+            member["served"] + member["shed"] + member["errors"]
+            == member["requests"]
+        ), member

@@ -818,3 +818,53 @@ def test_cascade_dispatcher_admission_path_known_bad(tmp_path):
         ("pkg/bad_cascade.py", 6, "score_texts"),
         ("pkg/bad_cascade.py", 8, "sleep"),
     ], hits
+
+
+def test_balancer_and_autoscaler_selection_only_known_bad(tmp_path):
+    """The fleet control-plane discipline (serving/fleet.py +
+    serving/autoscaler.py): a future ``*Balancer`` that sleeps in its
+    pick loop or scores inline, and a future ``*Autoscaler`` that warms
+    or installs a bank inside the decision path, fail MV102 — both by
+    class name and by base-class name — while the legal surface
+    (``_stop.wait``, ``check_health``, snapshot/status reads) stays
+    clean."""
+    _write_tree(tmp_path, {
+        "pkg/bad_fleet.py": (
+            "import time\n"
+            "class HostBalancer:\n"
+            "    def _pick(self, hosts):\n"
+            "        time.sleep(0.1)\n"
+            "        return hosts[0].service.score_texts(['probe'])\n"
+            "class Autoscaler:\n"
+            "    def tick(self):\n"
+            "        self.replica.service.install_bank(self.bank, [], 2)\n"
+            "class EagerAutoscaler(Autoscaler):\n"
+            "    def _grow(self):\n"
+            "        self.replica.service.predictor.warmup_compile()\n"
+        ),
+        "pkg/good_fleet.py": (
+            "class HostBalancer:\n"
+            "    def _pick(self, hosts):\n"
+            "        charged = {h.name: h.queue_depth for h in hosts}\n"
+            "        return min(hosts, key=lambda h: charged[h.name])\n"
+            "    def _monitor_loop(self):\n"
+            "        while not self._stop.wait(0.25):\n"
+            "            for host in self.hosts:\n"
+            "                host.check_health(10.0)\n"
+            "class Autoscaler:\n"
+            "    def tick(self):\n"
+            "        hint = self.slo_monitor.status().get('scale_hint')\n"
+            "        snap = self._tel.snapshot()\n"
+            "        return hint, snap\n"
+        ),
+    })
+    result = _analyze_fixture(tmp_path, select=["MV102"])
+    hits = sorted(
+        (f.path, f.line, f.symbol) for f in result.active
+    )
+    assert hits == [
+        ("pkg/bad_fleet.py", 4, "sleep"),
+        ("pkg/bad_fleet.py", 5, "score_texts"),
+        ("pkg/bad_fleet.py", 8, "install_bank"),
+        ("pkg/bad_fleet.py", 11, "warmup_compile"),
+    ], hits

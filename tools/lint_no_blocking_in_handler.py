@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """Lint: HTTP handler threads may only enqueue + wait on a future,
-router dispatch classes may only select a replica queue, and
-``*Dispatcher`` admission paths may never sleep or round-trip the
-device per request.
+router dispatch classes may only select a replica queue, ``*Balancer``
+and ``*Autoscaler`` classes may only decide from cached host/hint
+state, and ``*Dispatcher`` admission paths may never sleep or
+round-trip the device per request.
 
 Thin shim over the shared static-analysis engine
 (``memvul_tpu/analysis/``, checker **MV102** — docs/static_analysis.md):
@@ -36,9 +37,10 @@ if str(_REPO) not in sys.path:
 
 def find_blocking_calls(package_dir: Path) -> List[str]:
     """``path:line: name`` for every forbidden call inside a
-    ``*RequestHandler`` subclass, a ``*Router`` dispatch class, or a
-    ``*Dispatcher`` strategy class under ``package_dir``, via the
-    shared engine's MV102 checker."""
+    ``*RequestHandler`` subclass, a ``*Router`` dispatch class, a
+    ``*Balancer``/``*Autoscaler`` control class, or a ``*Dispatcher``
+    strategy class under ``package_dir``, via the shared engine's
+    MV102 checker."""
     from memvul_tpu.analysis import run_tool_checkers
 
     package_dir = Path(package_dir)
