@@ -365,6 +365,18 @@ def cmd_serve(args) -> int:
                 [ProcessHost(i, url=u) for i, u in enumerate(urls)],
                 config=FleetConfig(),
             )
+            if args.tsdb_cadence and args.tsdb_cadence > 0:
+                # the balancer has no archive config to read the knob
+                # from — the flag is the only gate in fleet mode.  Its
+                # sampler labels every host's part, and a quarantine
+                # bundles the merged fleet view.
+                from .serving.incident import attach_flight_recorder
+
+                attach_flight_recorder(
+                    service,
+                    run_dir=args.out_dir,
+                    cadence_s=args.tsdb_cadence,
+                )
         else:
             if not args.archive:
                 print(
@@ -380,6 +392,7 @@ def cmd_serve(args) -> int:
                 mesh=mesh,
                 use_mesh=not args.no_mesh,
                 replicas=args.replicas,
+                tsdb_cadence=args.tsdb_cadence,
             )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
@@ -411,7 +424,10 @@ def cmd_serve(args) -> int:
             stop.wait(0.5)
     finally:
         server.shutdown()
-        for attr in ("drift_monitor", "slo_monitor", "autoscaler"):
+        for attr in (
+            "drift_monitor", "slo_monitor", "autoscaler",
+            "alert_engine", "metrics_sampler", "incident_recorder",
+        ):
             monitor = getattr(service, attr, None)
             if monitor is not None:
                 monitor.stop()
@@ -832,6 +848,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=8341,
                    help="bind port (0 = ephemeral; the bound address is "
                    "printed as one JSON line on stdout)")
+    p.add_argument("--tsdb-cadence", type=float, default=None,
+                   metavar="SECONDS",
+                   help="metrics-history sampling cadence: turns on the "
+                   "in-process TSDB (GET /metricsz), alert rules (GET "
+                   "/alertz), and — with --out-dir — the incident "
+                   "flight recorder (docs/observability.md); default: "
+                   "the archive's telemetry.tsdb_cadence_s (0 = off, "
+                   "nothing constructed)")
     p.add_argument("--mesh", default=None)
     p.add_argument("--no-mesh", action="store_true")
     p.set_defaults(fn=cmd_serve)

@@ -17,6 +17,13 @@ delegating shim).  Four class families, wherever they live:
   cached health/queue/hint state and picks; kills, restart backoff,
   spawn warmups, and drain waits belong to the module-level recovery/
   scale workers on their own threads;
+* classes named ``*Recorder`` (or deriving from one;
+  serving/incident.py) — the incident flight recorder's trigger side
+  runs on whatever thread noticed the problem (router sweep, fleet
+  monitor, alert engine), so the same no-scoring/no-sleeping contract
+  applies: a trigger is a bounded-queue put and a dump reads
+  snapshots; a recorder that scored or slept inline would couple the
+  post-mortem plane to the request path it exists to observe;
 * classes named ``*Dispatcher`` (or deriving from one;
   serving/dispatch.py) — the batcher strategies themselves.  Their JOB
   is to encode, pack, and score, so the serving-surface names stay
@@ -111,6 +118,15 @@ def _is_balancer_class(node: ast.ClassDef) -> bool:
     return False
 
 
+def _is_recorder_class(node: ast.ClassDef) -> bool:
+    # the incident flight recorder (serving/incident.py): its trigger
+    # side runs on router/fleet/alert threads, so it inherits the full
+    # selection-only forbidden set
+    if node.name.endswith("Recorder"):
+        return True
+    return any(_base_name(b).endswith("Recorder") for b in node.bases)
+
+
 def _is_dispatcher_class(node: ast.ClassDef) -> bool:
     if node.name.endswith("Dispatcher"):
         return True
@@ -133,12 +149,14 @@ def check(ctx: AnalysisContext) -> Iterator[Finding]:
                 _is_handler_class(node)
                 or _is_router_class(node)
                 or _is_balancer_class(node)
+                or _is_recorder_class(node)
             ):
                 forbidden = FORBIDDEN_NAMES
                 contract = (
                     "a handler may only submit() and wait on the future; "
                     "a router/balancer/autoscaler may only select from "
-                    "cached state"
+                    "cached state; a recorder may only enqueue triggers "
+                    "and dump snapshots"
                 )
             elif _is_dispatcher_class(node):
                 forbidden = DISPATCHER_FORBIDDEN_NAMES

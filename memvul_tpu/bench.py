@@ -855,16 +855,26 @@ def _run_serve_micro() -> None:
         )
         return
 
-    def _drive_leg(impl: str) -> dict:
+    def _drive_leg(impl: str, tsdb_cadence: float = 0.0,
+                   tag: str = "") -> dict:
         """One closed-loop run: build the service for ``impl``, push the
         SAME seeded text schedule through it, return the leg record
         (rps, latency percentiles, and the padding ledger read from the
-        leg's own registry)."""
+        leg's own registry).  ``tsdb_cadence > 0`` attaches a live
+        :class:`~memvul_tpu.telemetry.timeseries.MetricsSampler` for the
+        duration of the load — the sampler-overhead leg."""
         from memvul_tpu.telemetry.registry import TelemetryRegistry
 
         registry = TelemetryRegistry(enabled=True)
-        with watchdog.phase(f"anchor_encode_{impl}"):
+        with watchdog.phase(f"anchor_encode_{impl}{tag}"):
             service = build_service(registry=registry, impl=impl)
+        sampler = None
+        if tsdb_cadence > 0:
+            from memvul_tpu.telemetry.timeseries import MetricsSampler
+
+            sampler = MetricsSampler(
+                service, cadence_s=tsdb_cadence, registry=registry
+            )
         client = InprocessClient(service)
         work: "_queue.SimpleQueue" = _queue.SimpleQueue()
         for text in texts:
@@ -889,9 +899,9 @@ def _run_serve_micro() -> None:
                 latencies.extend(own)
 
         # warmup trickle so pools/allocator ramp isn't billed to the load
-        with watchdog.phase(f"serve_warmup_{impl}"):
+        with watchdog.phase(f"serve_warmup_{impl}{tag}"):
             client.score(texts[0], deadline_ms=0)
-        with watchdog.phase(f"serve_load_{impl}"):
+        with watchdog.phase(f"serve_load_{impl}{tag}"):
             threads = [
                 threading.Thread(target=_client_loop, daemon=True)
                 for _ in range(n_clients)
@@ -902,6 +912,8 @@ def _run_serve_micro() -> None:
             for t in threads:
                 t.join()
             elapsed = time.perf_counter() - start
+        if sampler is not None:
+            sampler.stop()
         service.drain()
         snap = registry.snapshot()
         counters = snap["counters"]
@@ -941,6 +953,19 @@ def _run_serve_micro() -> None:
             ),
             "queue_wait_ms": queue_wait_ms,
         }
+        if sampler is not None:
+            ts = snap.get("histograms", {}).get("tsdb.sample_s")
+            leg["tsdb"] = {
+                "cadence_s": tsdb_cadence,
+                "samples": int(counters.get("tsdb.samples", 0)),
+                "sample_errors": int(counters.get("tsdb.sample_errors", 0)),
+                "series": sampler.store.series_count,
+                "sample_ms": (
+                    {"mean": round(ts["mean"] * 1e3, 3),
+                     "p95": round(ts["p95"] * 1e3, 3)}
+                    if ts and ts.get("count") else None
+                ),
+            }
         if impl == "cascade":
             # the quantization ledger: how much traffic the int8 tier
             # answered alone vs re-dispatched into the fp32 rescue band
@@ -965,6 +990,14 @@ def _run_serve_micro() -> None:
     # so the metric's meaning is stable across records); single-leg runs
     # report their own leg
     primary = by_leg["continuous"] if impl_mode == "ab" else records[-1]
+    # TSDB sampler-overhead leg (ROADMAP chip-window item): re-drive the
+    # primary impl with a live MetricsSampler attached and report
+    # on-vs-off; the "0.0" default keeps the record byte-identical
+    tsdb_cadence = float(os.environ.get("BENCH_SERVE_TSDB_CADENCE", "0.0"))
+    tsdb_on = (
+        _drive_leg(primary["impl"], tsdb_cadence=tsdb_cadence, tag="_tsdb")
+        if tsdb_cadence > 0 else None
+    )
     record = {
         "metric": "serve_microbench",
         "value": primary["requests_per_sec"],
@@ -998,6 +1031,24 @@ def _run_serve_micro() -> None:
         },
         **_program_blocks(),
     }
+    if tsdb_on is not None:
+        off_rps = primary["requests_per_sec"]
+        record["tsdb"] = {
+            "cadence_s": tsdb_cadence,
+            "off": {
+                "requests_per_sec": off_rps,
+                "latency_ms": primary["latency_ms"],
+            },
+            "on": {
+                "requests_per_sec": tsdb_on["requests_per_sec"],
+                "latency_ms": tsdb_on["latency_ms"],
+            },
+            "sampler": tsdb_on.get("tsdb"),
+            "throughput_ratio": (
+                round(tsdb_on["requests_per_sec"] / off_rps, 4)
+                if off_rps else None
+            ),
+        }
     if impl_mode == "ab":
         by_impl = by_leg
         record["ab"] = by_impl

@@ -231,9 +231,28 @@ def train_from_config(
     # opt-in live scrape surface for the (multi-hour) run: /metrics +
     # /programz on a daemon thread; 0 (the default) constructs nothing
     metrics_port = int(tel_cfg["metrics_port"] or 0)
-    metrics_server = (
-        telemetry.start_metrics_server(metrics_port) if metrics_port else None
-    )
+    metrics_server = None
+    if metrics_port:
+        # the history plane rides the exposition server: with both
+        # knobs on, /metricsz + /alertz answer over a sampler of the
+        # process-wide parts; cadence 0 (default) constructs nothing
+        sampler = engine = None
+        tsdb_cadence = float(tel_cfg["tsdb_cadence_s"] or 0.0)
+        if tsdb_cadence > 0:
+            from .telemetry.live import live_parts
+
+            sampler = telemetry.MetricsSampler(
+                live_parts,
+                store=telemetry.TimeSeriesStore(
+                    resolution_s=float(tel_cfg["tsdb_resolution_s"]),
+                    retention_s=float(tel_cfg["tsdb_retention_s"]),
+                ),
+                cadence_s=tsdb_cadence,
+            )
+            engine = telemetry.AlertEngine(sampler.store)
+        metrics_server = telemetry.start_metrics_server(
+            metrics_port, sampler=sampler, engine=engine
+        )
 
     seed = int(config.get("random_seed", 2021))
     tokenizer = build_tokenizer(config.get("tokenizer"))
@@ -330,6 +349,7 @@ def serve_from_archive(
     mesh=None,
     use_mesh: bool = False,
     replicas: Optional[int] = None,
+    tsdb_cadence: Optional[float] = None,
 ):
     """Build a ready :class:`~memvul_tpu.serving.ScoringService` — or,
     with ``replicas > 1`` (argument or the archive's
@@ -449,6 +469,17 @@ def serve_from_archive(
             "serving.trace_sample_rate must be in [0, 1], got "
             f"{trace_sample_rate!r}"
         )
+    # the metrics-history plane (telemetry/timeseries.py + alerts +
+    # serving/incident.py); the argument (the --tsdb-cadence CLI flag)
+    # overrides the archive's telemetry.tsdb_cadence_s.  0 (default) =
+    # off = nothing constructed, nothing emitted
+    tsdb_cadence = float(
+        tel_cfg["tsdb_cadence_s"] if tsdb_cadence is None else tsdb_cadence
+    )
+    if tsdb_cadence < 0:
+        raise ValueError(
+            f"telemetry.tsdb_cadence_s must be >= 0, got {tsdb_cadence!r}"
+        )
     service_config = ServiceConfig(
         max_batch=int(serve_cfg["max_batch"]),
         max_wait_ms=float(serve_cfg["max_wait_ms"]),
@@ -509,6 +540,31 @@ def serve_from_archive(
                 )
         return target
 
+    def _with_flight_recorder(target):
+        # the post-hoc "what happened" plane (docs/observability.md):
+        # TSDB sampler + alert rules + (with out_dir) incident bundles.
+        # attach_flight_recorder is the single on/off gate — cadence 0
+        # returns the target untouched, constructing nothing, so the
+        # default run's emitted metric/event set stays byte-identical.
+        # Must wrap LAST: the sampler/recorder see slo_monitor and
+        # autoscaler attributes only if they are already attached.
+        if tsdb_cadence > 0:
+            from .serving.incident import attach_flight_recorder
+
+            attach_flight_recorder(
+                target,
+                run_dir=out_dir,
+                registry=telemetry.get_registry(),
+                cadence_s=tsdb_cadence,
+                resolution_s=float(tel_cfg["tsdb_resolution_s"]),
+                retention_s=float(tel_cfg["tsdb_retention_s"]),
+                alert_interval_s=float(serve_cfg["alert_interval_s"]),
+                min_interval_s=float(serve_cfg["incident_min_interval_s"]),
+                max_bundles=int(serve_cfg["incident_max_bundles"]),
+                window_s=float(serve_cfg["incident_window_s"]),
+            )
+        return target
+
     if n_replicas <= 1:
         if mesh is None and use_mesh and len(jax.devices()) > 1:
             from .parallel.mesh import create_mesh
@@ -531,11 +587,13 @@ def serve_from_archive(
             cascade_high=cascade_high,
         )
         predictor.encode_anchors(anchors)
-        return _with_slo_monitor(_with_drift_monitor(ScoringService(
-            predictor,
-            config=service_config,
-            retry_policy=retry_policy,
-            manifest_dir=out_dir,
+        return _with_flight_recorder(_with_slo_monitor(_with_drift_monitor(
+            ScoringService(
+                predictor,
+                config=service_config,
+                retry_policy=retry_policy,
+                manifest_dir=out_dir,
+            )
         )))
 
     # -- replica fan-out: one service per assigned local device ------------
@@ -652,7 +710,7 @@ def serve_from_archive(
             retry_policy=retry_policy,
             run_dir=out_dir,
         )
-    return target
+    return _with_flight_recorder(target)
 
 
 def score_corpus_from_archive(

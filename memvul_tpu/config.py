@@ -401,6 +401,15 @@ SERVING_DEFAULTS: Dict[str, Any] = {
     "autoscale_up_consecutive": 2,    # agreeing "up" ticks before acting
     "autoscale_down_consecutive": 4,  # agreeing "down" ticks before acting
     "autoscale_drain_timeout_s": 10.0,  # retire: in-flight completion bound
+    # incident flight recorder (serving/incident.py; docs/
+    # observability.md "Incident bundles").  Only constructed when the
+    # history plane is on (telemetry.tsdb_cadence_s > 0): alert firings
+    # / replica deaths / host quarantines / autoscaler refusals dump a
+    # rate-limited, retention-bounded incidents/<ts>-<trigger>/ bundle
+    "alert_interval_s": 5.0,        # alert-rule evaluation cadence
+    "incident_min_interval_s": 30.0,  # bundle rate limit (dups dropped)
+    "incident_max_bundles": 8,        # newest-N bundle retention
+    "incident_window_s": 120.0,       # metric-history span per bundle
 }
 
 
@@ -458,6 +467,15 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
     # run's emitted metric/event set stays identical to a build without
     # the server; any other value binds that port (0 < p < 65536)
     "metrics_port": 0,
+    # in-process metrics history (telemetry/timeseries.py): a sampler
+    # thread snapshots the registry (plus per-replica / per-host parts
+    # in serving) into bounded (ts, value) rings, served as GET
+    # /metricsz and fed to alert rules + incident bundles.  0.0
+    # (default) = off — nothing is constructed and the emitted
+    # metric/event set stays byte-identical to a build without it
+    "tsdb_cadence_s": 0.0,
+    "tsdb_resolution_s": 1.0,   # ring bucket width (points coalesce)
+    "tsdb_retention_s": 600.0,  # per-series history span
 }
 
 

@@ -49,6 +49,11 @@ point              fires
                    factory runs (serving/autoscaler.py) — a firing is
                    a failed spawn the scaler must retry through its
                    RetryPolicy and then refuse machine-readably
+``incident.dump``  inside the flight recorder's worker thread, before a
+                   bundle is written (serving/incident.py) — a firing
+                   lands in ``incident.dump_errors`` and must never
+                   block or delay request resolution (the trigger side
+                   is a non-blocking bounded-queue put)
 =================  ==========================================================
 
 With no configuration every point is a near-zero-cost no-op.  Arming is
@@ -108,6 +113,7 @@ REGISTERED_POINTS = frozenset({
     "host.kill",
     "host.stall",
     "scaler.spawn",
+    "incident.dump",
 })
 REGISTERED_POINT_PREFIXES = (
     "step.", "replica.kill.", "shard.kill.", "shard.stall.",

@@ -64,6 +64,17 @@ from .slo import SCALE_DOWN, SCALE_HOLD, SCALE_UP, _HINT_GAUGE
 
 logger = logging.getLogger(__name__)
 
+# the metric window attached to each decision when the TSDB is on
+# (serving/incident.py wires ``metrics_store``): the series that
+# justify a hint — burn rates, replica count, queue depth
+_DECISION_METRICS = (
+    "slo.burn_rate_fast",
+    "slo.burn_rate_slow",
+    "scaler.replicas",
+    "serve.queue_depth",
+)
+_DECISION_WINDOW_S = 60.0
+
 
 @dataclasses.dataclass(frozen=True)
 class AutoscalerConfig:
@@ -242,7 +253,11 @@ class Autoscaler:
 
     def _observe(self, hint: str, action: Optional[str], now: float) -> None:
         """Append one trajectory point (the bench record's
-        replica-count-vs-time curve) — bounded ring."""
+        replica-count-vs-time curve) — bounded ring — and emit it as a
+        ``scaler_decision`` event so post-mortems survive the process
+        (the in-memory deque dies with it).  When the history plane is
+        on (``metrics_store`` set by serving/incident.py), the stored
+        point also carries the metric window that justified it."""
         slo = self.slo_monitor.status()
         point = {
             "t_s": round(now - self._started, 3),
@@ -252,6 +267,16 @@ class Autoscaler:
             "burn_rate_fast": slo.get("burn_rate_fast"),
             "backlog": slo.get("backlog"),
         }
+        self._tel.event("scaler_decision", **point)
+        store = getattr(self, "metrics_store", None)
+        if store is not None:
+            try:
+                point = dict(point)
+                point["window"] = store.window(
+                    _DECISION_METRICS, _DECISION_WINDOW_S
+                )
+            except Exception:  # pragma: no cover - a torn store read
+                pass  # must not cost a control decision
         with self._lock:
             self.history.append(point)
             if len(self.history) > self.config.history:
@@ -325,6 +350,9 @@ def _spawn_replica(scaler: Autoscaler) -> None:
             tel.counter("scaler.spawn_failures").inc()
             tel.event("scaler_spawn_refused", **refusal)
             logger.error("spawn %s refused: %s", name, refusal["reason"])
+            recorder = getattr(scaler, "incident_recorder", None)
+            if recorder is not None:  # refusals are incident triggers
+                recorder.trigger("scaler_spawn_refused", refusal)
             return
         _sync_bank(router, replica)
         router.admit_replica(replica)

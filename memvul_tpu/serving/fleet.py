@@ -871,6 +871,9 @@ def _recover_host(balancer: HostBalancer, host) -> None:
         host.kill(reason="fleet monitor: dead or stalled")
         tel.counter("fleet.host_deaths").inc()
         tel.event("fleet_host_dead", host=host.name)
+        recorder = getattr(balancer, "incident_recorder", None)
+        if recorder is not None:  # non-blocking bounded-queue put
+            recorder.trigger("host_dead", {"host": host.name})
         balancer._reclaim(host, reason=f"{host.name} lost")
         if (
             not cfg.auto_restart
@@ -907,6 +910,11 @@ def _quarantine_host(balancer: HostBalancer, host, reason: str) -> None:
         host=host.name, restarts=host.restart_count, reason=reason[:200],
     )
     logger.error("%s quarantined: %s", host.name, reason)
+    recorder = getattr(balancer, "incident_recorder", None)
+    if recorder is not None:  # non-blocking bounded-queue put
+        recorder.trigger(
+            "host_quarantined", {"host": host.name, "reason": reason[:200]}
+        )
 
 
 def enumerate_hosts(

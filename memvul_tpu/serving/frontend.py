@@ -36,6 +36,13 @@ table):
   compile first, plus the aggregate roofline reading
   (telemetry/programs.py; a router target merges every replica's rows
   with ``replica`` stamps).
+* ``GET /metricsz[?window=S][&metric=prefix]`` → the in-process metric
+  history rings as JSON (telemetry/timeseries.py) when the sampler is
+  on (``telemetry.tsdb_cadence_s`` > 0); ``{"enabled": false}``
+  otherwise — the endpoint itself never 404s.
+* ``GET /alertz`` → the alert engine's rule table + currently-firing
+  records (telemetry/alerts.py); ``{"enabled": false}`` when the
+  history plane is off.
 * ``POST /profilez`` with ``{"seconds": N}`` → starts an on-demand
   ``jax.profiler`` capture into the run dir while traffic keeps
   flowing; 409 while one is already running, 503 when the server was
@@ -185,6 +192,39 @@ class ScoreHandler(BaseHTTPRequestHandler):
                 payload["roofline"] = roofline()
             self._reply(200, payload)
             return
+        if path == "/metricsz":
+            # metric history rings — a snapshot copy under the store
+            # lock, same discipline as every other read endpoint.  The
+            # sampler is attached by serving/incident.py's
+            # attach_flight_recorder; absent (the default-off config)
+            # the endpoint answers {"enabled": false} rather than 404
+            # so probes can distinguish "off" from "wrong path"
+            params = urllib.parse.parse_qs(query)
+            try:
+                window_s = (
+                    float(params["window"][0]) if "window" in params else None
+                )
+            except (TypeError, ValueError):
+                self._reply(400, {
+                    "status": "error", "reason": "window must be a number",
+                })
+                return
+            metric = params["metric"][0] if "metric" in params else None
+            sampler = getattr(service, "metrics_sampler", None)
+            if sampler is None:
+                self._reply(200, {"enabled": False, "series": 0, "history": {}})
+                return
+            payload = sampler.status()
+            payload["history"] = sampler.history(window_s, metric)
+            self._reply(200, payload)
+            return
+        if path == "/alertz":
+            engine = getattr(service, "alert_engine", None)
+            if engine is None:
+                self._reply(200, {"enabled": False, "firing": [], "rules": []})
+                return
+            self._reply(200, engine.status())
+            return
         self._reply(404, {"status": "error", "reason": "unknown path"})
 
     def _do_profilez(self) -> None:
@@ -282,7 +322,7 @@ def run_http_server(
     logger.info(
         "scoring service listening on http://%s:%d (POST /score, GET "
         "/healthz, GET /metrics, GET /tracez, GET /programz, "
-        "POST /profilez)",
+        "GET /metricsz, GET /alertz, POST /profilez)",
         *server.server_address[:2],
     )
     return server

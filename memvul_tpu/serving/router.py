@@ -439,6 +439,9 @@ class ReplicaRouter:
         if dead:
             self._tel.counter("router.replica_deaths").inc()
             self._tel.event("replica_dead", replica=replica.name)
+            recorder = getattr(self, "incident_recorder", None)
+            if recorder is not None:  # non-blocking bounded-queue put
+                recorder.trigger("replica_dead", {"replica": replica.name})
         thread = threading.Thread(
             target=_recover_replica,
             args=(self, replica, dead),

@@ -33,3 +33,13 @@ from .programs import (  # noqa: F401
     write_programs,
 )
 from .live import start_metrics_server  # noqa: F401
+from .timeseries import (  # noqa: F401
+    MetricsSampler,
+    TimeSeriesStore,
+    series_name,
+)
+from .alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+    default_rules,
+)

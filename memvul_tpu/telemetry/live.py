@@ -17,7 +17,10 @@ holds here exactly as it does for the serving frontend):
   ``xla.*`` program part, Prometheus text format;
 * ``GET /programz`` — the program registry's newest-compile-first rows
   as JSON;
-* ``GET /healthz``  — phase + heartbeat age, the liveness probe.
+* ``GET /healthz``  — phase + heartbeat age, the liveness probe;
+* ``GET /metricsz`` / ``GET /alertz`` — the in-process metric history
+  and alert state (telemetry/timeseries.py, telemetry/alerts.py) when
+  ``telemetry.tsdb_cadence_s`` > 0; ``{"enabled": false}`` otherwise.
 
 Default-off is load-bearing: with ``metrics_port`` 0 nothing here is
 constructed, imported state stays untouched, and the run's emitted
@@ -29,8 +32,9 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List
+from typing import List, Optional
 
 from .exposition import SnapshotPart, render_exposition
 from .programs import get_program_registry
@@ -64,8 +68,15 @@ class _LiveMetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_json(self, status: int, payload) -> None:
+        self._reply(
+            status,
+            json.dumps(payload, default=float).encode("utf-8"),
+            "application/json",
+        )
+
     def do_GET(self) -> None:
-        path = self.path.partition("?")[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             text = render_exposition(live_parts())
             self._reply(
@@ -96,6 +107,40 @@ class _LiveMetricsHandler(BaseHTTPRequestHandler):
                 200, json.dumps(payload).encode("utf-8"), "application/json"
             )
             return
+        if path == "/metricsz":
+            # metric history rings (telemetry/timeseries.py) — snapshot
+            # copies only, same as the serving frontend's route
+            params = urllib.parse.parse_qs(query)
+            try:
+                window_s = (
+                    float(params["window"][0]) if "window" in params else None
+                )
+            except (TypeError, ValueError):
+                self._reply_json(
+                    400,
+                    {"status": "error", "reason": "window must be a number"},
+                )
+                return
+            metric = params["metric"][0] if "metric" in params else None
+            sampler = getattr(self.server, "sampler", None)
+            if sampler is None:
+                self._reply_json(
+                    200, {"enabled": False, "series": 0, "history": {}}
+                )
+                return
+            payload = sampler.status()
+            payload["history"] = sampler.history(window_s, metric)
+            self._reply_json(200, payload)
+            return
+        if path == "/alertz":
+            engine = getattr(self.server, "engine", None)
+            if engine is None:
+                self._reply_json(
+                    200, {"enabled": False, "firing": [], "rules": []}
+                )
+                return
+            self._reply_json(200, engine.status())
+            return
         self._reply(
             404,
             json.dumps({"status": "error", "reason": "unknown path"}).encode(
@@ -113,8 +158,13 @@ class LiveMetricsServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
-    def __init__(self, address) -> None:
+    def __init__(self, address, sampler=None, engine=None) -> None:
         super().__init__(address, _LiveMetricsHandler)
+        # the history plane, when the run turned it on (tsdb_cadence_s
+        # > 0 in build.train_from_config); None keeps /metricsz and
+        # /alertz answering {"enabled": false}
+        self.sampler = sampler
+        self.engine = engine
         self._thread: threading.Thread = threading.Thread(
             target=self.serve_forever, name="memvul-metrics-http", daemon=True
         )
@@ -124,7 +174,7 @@ class LiveMetricsServer(ThreadingHTTPServer):
         self._thread.start()
         logger.info(
             "live telemetry exposition on http://%s:%d "
-            "(GET /metrics, /programz, /healthz)",
+            "(GET /metrics, /programz, /healthz, /metricsz, /alertz)",
             *self.server_address[:2],
         )
         return self
@@ -135,11 +185,22 @@ class LiveMetricsServer(ThreadingHTTPServer):
         self._closed = True
         self.shutdown()
         self.server_close()
+        # the server owns the sampler/engine threads it was started
+        # with (build passes freshly-constructed ones): stop them with
+        # the port so a preempted run unwinds cleanly
+        for worker in (self.sampler, self.engine):
+            if worker is not None:
+                worker.stop()
 
 
 def start_metrics_server(
-    port: int, host: str = "127.0.0.1"
+    port: int,
+    host: str = "127.0.0.1",
+    sampler=None,
+    engine: Optional[object] = None,
 ) -> LiveMetricsServer:
     """Bind and start the live exposition server (port 0 = ephemeral;
-    read the bound port off ``server.server_address``)."""
-    return LiveMetricsServer((host, port)).start()
+    read the bound port off ``server.server_address``).  ``sampler`` /
+    ``engine`` attach the metric-history plane to /metricsz + /alertz;
+    ``close()`` stops them with the port."""
+    return LiveMetricsServer((host, port), sampler=sampler, engine=engine).start()

@@ -614,23 +614,131 @@ def _fleet_block(
 
 
 def _autoscaler_block(
-    counters: Dict[str, Any], gauges: Dict[str, Any]
+    counters: Dict[str, Any],
+    gauges: Dict[str, Any],
+    events: Optional[List[Dict[str, Any]]] = None,
 ) -> Optional[Dict[str, Any]]:
     """The ``autoscaler`` block of the ``--json`` report (and the
-    AUTOSCALER text section): scale_hint actuation totals
-    (serving/autoscaler.py).  None when no autoscaler ran."""
+    AUTOSCALER text section): scale_hint actuation totals plus the
+    persisted ``scaler_decision`` trajectory (one event per control
+    tick — the in-memory decision deque dies with the process; these
+    survive in ``events.jsonl``).  None when no autoscaler ran."""
     scaler = {
         k.split(".", 1)[1]: v for k, v in counters.items()
         if k.startswith("scaler.")
     }
     replicas = gauges.get("scaler.replicas")
-    if not scaler and replicas is None:
+    decisions = [
+        ev for ev in (events or []) if ev.get("kind") == "scaler_decision"
+    ]
+    if not scaler and replicas is None and not decisions:
         return None
-    return {
+    out: Dict[str, Any] = {
         "replicas": replicas,
         "hint": gauges.get("scaler.hint"),
         "counters": scaler,
     }
+    if decisions:
+        acted = [d for d in decisions if d.get("action")]
+        out["decisions"] = {
+            "ticks": len(decisions),
+            "acted": len(acted),
+            "last_actions": [
+                {
+                    "t_s": d.get("t_s"),
+                    "action": d.get("action"),
+                    "replicas": d.get("replicas"),
+                    "hint": d.get("hint"),
+                    "burn_rate_fast": d.get("burn_rate_fast"),
+                }
+                for d in acted[-8:]
+            ],
+        }
+    return out
+
+
+_ALERT_EVENT_KINDS = frozenset({"alert_fired", "alert_resolved"})
+
+
+def _alerts_block(
+    events: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """The ``alerts`` block of the ``--json`` report (and the ALERTS
+    text section): alert-rule transitions replayed from the event
+    stream (telemetry/alerts.py emits one ``alert_fired`` /
+    ``alert_resolved`` event per edge).  A rule that fired without a
+    matching resolve was still firing when the run ended — exactly the
+    post-mortem lead.  None when no alert engine ran."""
+    transitions = [
+        ev for ev in events if ev.get("kind") in _ALERT_EVENT_KINDS
+    ]
+    if not transitions:
+        return None
+    fired = resolved = 0
+    open_rules: Dict[str, Dict[str, Any]] = {}
+    for ev in transitions:
+        rule = str(ev.get("rule", "?"))
+        if ev.get("kind") == "alert_fired":
+            fired += 1
+            open_rules[rule] = ev
+        else:
+            resolved += 1
+            open_rules.pop(rule, None)
+    return {
+        "fired": fired,
+        "resolved": resolved,
+        "still_firing": sorted(open_rules),
+        "transitions": [
+            {
+                k: v for k, v in ev.items()
+                if k not in ("t", "mono", "phase")
+            }
+            for ev in transitions[-10:]
+        ],
+    }
+
+
+def load_incidents(run_dir: Union[str, Path]) -> List[Dict[str, Any]]:
+    """Summarize every incident bundle under ``run_dir/incidents/``
+    (serving/incident.py writes them; this reader lives in the
+    telemetry layer so ``telemetry-report`` never imports the serving
+    package).  Torn or missing bundle files degrade per-bundle — the
+    report is the post-mortem tool, it has no one to crash to."""
+    import json
+
+    incidents_dir = Path(run_dir) / "incidents"
+    out: List[Dict[str, Any]] = []
+    if not incidents_dir.is_dir():
+        return out
+    for bundle in sorted(p for p in incidents_dir.iterdir() if p.is_dir()):
+        record: Dict[str, Any] = {"bundle": bundle.name}
+        try:
+            manifest = json.loads((bundle / "manifest.json").read_text())
+            record["trigger"] = manifest.get("trigger")
+            record["wall"] = manifest.get("wall")
+            alerts = manifest.get("alerts")
+            if isinstance(alerts, dict):
+                record["firing"] = [
+                    str(r.get("rule", "?"))
+                    for r in alerts.get("firing") or []
+                ]
+            record["detail"] = manifest.get("detail")
+        except (OSError, ValueError) as exc:
+            record["error"] = f"{type(exc).__name__}: {exc}"
+        try:
+            history = json.loads(
+                (bundle / "metrics.json").read_text()
+            ).get("history") or {}
+            record["series"] = len(history)
+        except (OSError, ValueError, AttributeError):
+            record["series"] = 0
+        try:
+            traces = json.loads((bundle / "traces.json").read_text())
+            record["traces"] = len(traces) if isinstance(traces, list) else 0
+        except (OSError, ValueError):
+            record["traces"] = 0
+        out.append(record)
+    return out
 
 
 def report_json(
@@ -642,8 +750,8 @@ def report_json(
     keys are pinned by tests (the ``lint --json`` pattern): ``schema``,
     ``run_dir``, ``events``, ``heartbeat``, ``spans``, ``counters``,
     ``gauges``, ``histograms``, ``derived``, ``latency_decomposition``,
-    ``cascade``, ``fleet``, ``autoscaler``, ``replicas``, ``shards``,
-    ``programs``, ``roofline``."""
+    ``cascade``, ``fleet``, ``autoscaler``, ``alerts``, ``incidents``,
+    ``replicas``, ``shards``, ``programs``, ``roofline``."""
     data = load_run(run_dir)
     now = time.time() if now is None else now
     summary = data["summary"]
@@ -679,8 +787,10 @@ def report_json(
         "cascade": _cascade_block(counters, programs["programs"]),
         "fleet": _fleet_block(counters, dict(summary.get("gauges") or {})),
         "autoscaler": _autoscaler_block(
-            counters, dict(summary.get("gauges") or {})
+            counters, dict(summary.get("gauges") or {}), data["events"]
         ),
+        "alerts": _alerts_block(data["events"]),
+        "incidents": load_incidents(data["run_dir"]),
         "replicas": _replica_rows(data["run_dir"], data["events"], now),
         "shards": _shard_rows(data["run_dir"], data["events"], now),
         "programs": programs["programs"],
@@ -925,7 +1035,7 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
             lines.append(f"  {host}: heartbeat_age={_fmt_s(age)}")
 
     # -- autoscaler (serving/autoscaler.py) ------------------------------------
-    scaler = _autoscaler_block(counters, gauges)
+    scaler = _autoscaler_block(counters, gauges, events)
     if scaler:
         lines.append("")
         lines.append("AUTOSCALER (scale_hint actuation)")
@@ -937,6 +1047,64 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
             f"  downs: {_fmt_num(sc.get('scale_downs', 0))}"
             f"  spawn_failures: {_fmt_num(sc.get('spawn_failures', 0))}"
         )
+        decisions = scaler.get("decisions")
+        if decisions:
+            lines.append(
+                f"  decisions: {decisions['ticks']} ticks,"
+                f" {decisions['acted']} acted"
+            )
+            for d in decisions["last_actions"]:
+                lines.append(
+                    f"    +{_fmt_num(d.get('t_s', '?'))}s"
+                    f" {d.get('action')}"
+                    f" → {_fmt_num(d.get('replicas', '?'))} replicas"
+                    f" (hint={d.get('hint')}"
+                    f" burn_fast={_fmt_num(d.get('burn_rate_fast'))})"
+                )
+
+    # -- alert-rule transitions (telemetry/alerts.py) --------------------------
+    alerts = _alerts_block(events)
+    if alerts:
+        lines.append("")
+        lines.append("ALERTS")
+        lines.append(
+            f"  fired: {alerts['fired']}  resolved: {alerts['resolved']}"
+            + (
+                "  STILL FIRING: " + ", ".join(alerts["still_firing"])
+                if alerts["still_firing"] else ""
+            )
+        )
+        for ev in alerts["transitions"]:
+            if ev.get("kind") == "alert_fired":
+                lines.append(
+                    f"  fired {ev.get('rule')}:"
+                    f" value={_fmt_num(ev.get('value'))}"
+                    f" series={ev.get('series')}"
+                )
+            else:
+                lines.append(
+                    f"  resolved {ev.get('rule')}:"
+                    f" after {_fmt_s(ev.get('duration_s'))}"
+                )
+
+    # -- incident bundles (serving/incident.py) --------------------------------
+    incidents = load_incidents(data["run_dir"])
+    if incidents:
+        lines.append("")
+        lines.append("INCIDENTS (flight-recorder bundles)")
+        for rec in incidents:
+            if "error" in rec:
+                lines.append(f"  {rec['bundle']}: (torn: {rec['error']})")
+                continue
+            lines.append(
+                f"  {rec['bundle']}: trigger={rec.get('trigger')}"
+                f"  series={rec.get('series', 0)}"
+                f"  traces={rec.get('traces', 0)}"
+                + (
+                    "  firing=" + ",".join(rec["firing"])
+                    if rec.get("firing") else ""
+                )
+            )
 
     # -- replicas (scale-out serving runs) ------------------------------------
     replica_lines = _replica_section(data["run_dir"], events, now)

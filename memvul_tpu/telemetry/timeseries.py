@@ -1,0 +1,322 @@
+"""Bounded in-process metrics history — the "what happened" plane.
+
+Every live surface so far is point-in-time: ``GET /metrics`` is a
+snapshot, ``/tracez`` a small ring, and the SLO monitor's burn rates
+evaporate the moment they change.  Unless an external Prometheus
+happened to be scraping, the 3am question — *why* did the fleet flap,
+*when* did HBM start growing — has no answer.  This module keeps a
+small sliding window of history inside the process itself:
+
+* :class:`TimeSeriesStore` — per-``(labels, metric)`` rings of
+  ``(wall_ts, value)`` points.  Gauges are stored as-is; counters are
+  stored as **derived per-second rates** under ``<name>.rate`` (the
+  raw monotone totals are already in the snapshot — the interesting
+  signal is the slope); histogram summaries contribute their
+  ``mean``/``p50``/``p95`` as separate series.  ``resolution_s``
+  coalesces points closer together than one bucket, ``retention_s``
+  bounds each ring, so memory is ``O(series × retention/resolution)``
+  regardless of sampler cadence.
+* :class:`MetricsSampler` — a daemon thread that snapshots a target at
+  a fixed cadence into one store.  A serving target's
+  ``metrics_snapshots()`` is sampled when it has one (so the router's
+  per-``replica`` parts and the ``HostBalancer``'s per-``host`` parts
+  label their history for free, exactly like a ``/metrics`` scrape);
+  a bare :class:`~memvul_tpu.telemetry.registry.TelemetryRegistry` or
+  a parts-returning callable (``telemetry.live.live_parts``) works
+  too.
+
+Served as ``GET /metricsz?window=&metric=`` by the serving frontend
+and the live exposition server, fed to ``telemetry/alerts.py`` rule
+evaluation, and dumped into incident bundles (serving/incident.py).
+
+Default-off is load-bearing (the ``metrics_port`` discipline): with
+``telemetry.tsdb_cadence_s`` 0 nothing here is constructed and the
+run's emitted metric/event set stays byte-identical to the baseline.
+When a sampler *is* running it reports its own cost as ``tsdb.samples``
+/ ``tsdb.sample_errors`` counters, a ``tsdb.series`` gauge, and a
+``tsdb.sample_s`` histogram — the overhead figure the serve microbench
+records (bench.py, ``BENCH_SERVE_TSDB_CADENCE``).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .registry import get_registry
+
+logger = logging.getLogger(__name__)
+
+# (sorted (key, value) label pairs, metric name) — one ring per pair
+_SeriesKey = Tuple[Tuple[Tuple[str, str], ...], str]
+
+DEFAULT_RESOLUTION_S = 1.0
+DEFAULT_RETENTION_S = 600.0
+
+
+def series_name(metric: str, label_key: Tuple[Tuple[str, str], ...]) -> str:
+    """The flat Prometheus-style name a labeled series renders under in
+    ``/metricsz`` JSON, e.g. ``serve.requests.rate{replica="replica-0"}``."""
+    if not label_key:
+        return metric
+    inner = ",".join(f'{k}="{v}"' for k, v in label_key)
+    return f"{metric}{{{inner}}}"
+
+
+class TimeSeriesStore:
+    """Thread-safe bounded rings of metric history.
+
+    ``observe(parts)`` ingests one multi-part snapshot (the
+    ``SnapshotPart`` shape ``telemetry.exposition`` renders); readers
+    (``history``/``window``/``stats``) only copy under the lock — the
+    handler snapshot discipline (MV102) holds for every consumer."""
+
+    def __init__(
+        self,
+        resolution_s: float = DEFAULT_RESOLUTION_S,
+        retention_s: float = DEFAULT_RETENTION_S,
+    ) -> None:
+        resolution_s = float(resolution_s)
+        retention_s = float(retention_s)
+        if resolution_s <= 0:
+            raise ValueError(
+                f"tsdb resolution_s must be > 0, got {resolution_s!r}"
+            )
+        if retention_s < resolution_s:
+            raise ValueError(
+                "tsdb retention_s must be >= resolution_s, got "
+                f"{retention_s!r} < {resolution_s!r}"
+            )
+        self.resolution_s = resolution_s
+        self.retention_s = retention_s
+        self._maxlen = max(2, int(round(retention_s / resolution_s)))
+        self._lock = threading.Lock()
+        self._series: Dict[_SeriesKey, "collections.deque"] = {}
+        # last raw counter totals, for the rate derivation
+        self._prev_counters: Dict[_SeriesKey, Tuple[float, float]] = {}
+        self._samples = 0
+
+    # -- ingest ----------------------------------------------------------------
+
+    def observe(
+        self,
+        parts: Sequence[Tuple[Mapping[str, str], Mapping[str, Any]]],
+        now: Optional[float] = None,
+    ) -> None:
+        """Ingest one sample: every part's counters (as rates), gauges,
+        and histogram summaries, labeled like the exposition would."""
+        now = time.time() if now is None else float(now)
+        with self._lock:
+            self._samples += 1
+            for labels, snapshot in parts:
+                self._observe_part(dict(labels or {}), snapshot or {}, now)
+
+    def _observe_part(
+        self, labels: Dict[str, str], snapshot: Mapping[str, Any], now: float
+    ) -> None:
+        label_key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        for name, value in (snapshot.get("counters") or {}).items():
+            try:
+                total = float(value)
+            except (TypeError, ValueError):
+                continue
+            key = (label_key, str(name))
+            prev = self._prev_counters.get(key)
+            self._prev_counters[key] = (now, total)
+            if prev is None or now <= prev[0]:
+                continue
+            rate = max(0.0, total - prev[1]) / (now - prev[0])
+            self._append(label_key, f"{name}.rate", now, rate)
+        for name, value in (snapshot.get("gauges") or {}).items():
+            try:
+                self._append(label_key, str(name), now, float(value))
+            except (TypeError, ValueError):
+                continue
+        for name, summary in (snapshot.get("histograms") or {}).items():
+            if not isinstance(summary, Mapping):
+                continue
+            for field in ("mean", "p50", "p95"):
+                value = summary.get(field)
+                if value is None:
+                    continue
+                try:
+                    self._append(
+                        label_key, f"{name}.{field}", now, float(value)
+                    )
+                except (TypeError, ValueError):
+                    continue
+
+    def _append(
+        self,
+        label_key: Tuple[Tuple[str, str], ...],
+        metric: str,
+        now: float,
+        value: float,
+    ) -> None:
+        key = (label_key, metric)
+        ring = self._series.get(key)
+        if ring is None:
+            ring = self._series[key] = collections.deque(maxlen=self._maxlen)
+        if ring and now - ring[-1][0] < self.resolution_s:
+            # within one resolution bucket: keep the newest reading at
+            # the bucket's original timestamp (rings stay retention-bounded)
+            ring[-1] = (ring[-1][0], value)
+        else:
+            ring.append((now, value))
+
+    # -- read surfaces ---------------------------------------------------------
+
+    def history(
+        self,
+        window_s: Optional[float] = None,
+        metric: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> Dict[str, List[List[float]]]:
+        """``{series_name: [[ts, value], ...]}`` — the ``/metricsz``
+        body.  ``window_s`` keeps only points newer than ``now -
+        window_s``; ``metric`` filters by exact name or prefix (so
+        ``?metric=serve.`` selects the whole family)."""
+        now = time.time() if now is None else float(now)
+        cutoff = None if window_s is None else now - float(window_s)
+        out: Dict[str, List[List[float]]] = {}
+        with self._lock:
+            items = sorted(self._series.items(), key=lambda kv: (kv[0][1], kv[0][0]))
+            for (label_key, name), ring in items:
+                if metric and not (name == metric or name.startswith(metric)):
+                    continue
+                points = [
+                    [ts, value]
+                    for ts, value in ring
+                    if cutoff is None or ts >= cutoff
+                ]
+                if points:
+                    out[series_name(name, label_key)] = points
+        return out
+
+    def window(
+        self,
+        metrics: Sequence[str],
+        window_s: float,
+        now: Optional[float] = None,
+    ) -> Dict[str, List[List[float]]]:
+        """The justification slice an autoscaler decision carries: the
+        named metrics' recent points (all label sets), compact."""
+        now = time.time() if now is None else float(now)
+        cutoff = now - float(window_s)
+        wanted = set(metrics)
+        out: Dict[str, List[List[float]]] = {}
+        with self._lock:
+            for (label_key, name), ring in self._series.items():
+                if name not in wanted:
+                    continue
+                points = [[ts, value] for ts, value in ring if ts >= cutoff]
+                if points:
+                    out[series_name(name, label_key)] = points
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "series": len(self._series),
+                "samples": self._samples,
+                "resolution_s": self.resolution_s,
+                "retention_s": self.retention_s,
+            }
+
+    @property
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+
+class MetricsSampler:
+    """Daemon-thread sampler: one target, one store, one cadence.
+
+    ``target`` is sampled via its ``metrics_snapshots()`` when it has
+    one (service / router / balancer — per-member labels come free), a
+    parts-returning callable (``telemetry.live.live_parts``), or a bare
+    registry's ``snapshot()``.  ``start=False`` skips the thread so
+    tests drive :meth:`sample` deterministically."""
+
+    def __init__(
+        self,
+        target: Any,
+        store: Optional[TimeSeriesStore] = None,
+        cadence_s: float = 1.0,
+        registry=None,
+        start: bool = True,
+    ) -> None:
+        cadence_s = float(cadence_s)
+        if cadence_s <= 0:
+            # cadence 0 means "off", and off means NOT CONSTRUCTED —
+            # the wiring sites (build.serve_from_archive,
+            # serving.incident.attach_flight_recorder) own that gate
+            raise ValueError(
+                f"sampler cadence_s must be > 0, got {cadence_s!r}"
+            )
+        self.target = target
+        self.store = store if store is not None else TimeSeriesStore()
+        self.cadence_s = cadence_s
+        self._tel = registry if registry is not None else get_registry()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="memvul-tsdb-sampler", daemon=True
+            )
+            self._thread.start()
+
+    # -- one sample ------------------------------------------------------------
+
+    def _parts(self) -> Sequence[Tuple[Mapping[str, str], Mapping[str, Any]]]:
+        snapshots = getattr(self.target, "metrics_snapshots", None)
+        if snapshots is not None:
+            return snapshots()
+        if callable(self.target):  # live_parts-style provider
+            return self.target()
+        return [({}, self.target.snapshot())]
+
+    def sample(self, now: Optional[float] = None) -> None:
+        """Take one sample (the loop body; tests call it directly).  A
+        failing target read is counted, never raised — a half-dead
+        replica mid-sweep must not kill the history of its death."""
+        t0 = time.perf_counter()
+        try:
+            parts = self._parts()
+            self.store.observe(parts, now=now)
+        except Exception:
+            self._tel.counter("tsdb.sample_errors").inc()
+            logger.exception("tsdb sample failed")
+            return
+        self._tel.counter("tsdb.samples").inc()
+        self._tel.gauge("tsdb.series").set(self.store.series_count)
+        self._tel.histogram("tsdb.sample_s").observe(time.perf_counter() - t0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cadence_s):
+            self.sample()
+
+    # -- read surfaces ---------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/metricsz`` envelope (history attached by the handler)."""
+        return {
+            "enabled": True,
+            "cadence_s": self.cadence_s,
+            **self.store.stats(),
+        }
+
+    def history(
+        self,
+        window_s: Optional[float] = None,
+        metric: Optional[str] = None,
+    ) -> Dict[str, List[List[float]]]:
+        return self.store.history(window_s=window_s, metric=metric)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)

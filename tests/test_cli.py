@@ -723,7 +723,9 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         for action in sub.choices["serve"]._actions
         for flag in action.option_strings
     }
-    assert {"--replicas", "--out-dir", "--overrides", "--port"} <= serve_flags
+    assert {
+        "--replicas", "--out-dir", "--overrides", "--port", "--tsdb-cadence"
+    } <= serve_flags
     # the lint subcommand's flag surface is pinned too: the engine's
     # select/json/baseline workflow (docs/static_analysis.md) must stay
     # registered

@@ -868,3 +868,42 @@ def test_balancer_and_autoscaler_selection_only_known_bad(tmp_path):
         ("pkg/bad_fleet.py", 8, "install_bank"),
         ("pkg/bad_fleet.py", 11, "warmup_compile"),
     ], hits
+
+
+def test_recorder_trigger_path_known_bad(tmp_path):
+    """The flight-recorder discipline (serving/incident.py): a future
+    ``*Recorder`` that sleeps or scores on the trigger path — which
+    runs on router/fleet/alert threads — fails MV102, both by class
+    name and by base-class name, while the legal surface (bounded-queue
+    puts, snapshot/status reads, atomic dumps) stays clean."""
+    _write_tree(tmp_path, {
+        "pkg/bad_recorder.py": (
+            "import time\n"
+            "class IncidentRecorder:\n"
+            "    def trigger(self, kind):\n"
+            "        time.sleep(0.5)\n"
+            "        return self.service.score_texts(['probe'])\n"
+            "class EagerRecorder(IncidentRecorder):\n"
+            "    def _dump(self, kind):\n"
+            "        self.service.predictor.pack_token_budget([1], 8, 4)\n"
+        ),
+        "pkg/good_recorder.py": (
+            "class IncidentRecorder:\n"
+            "    def trigger(self, kind):\n"
+            "        self._queue.put_nowait((kind, {}))\n"
+            "    def _dump(self, kind):\n"
+            "        alerts = self.engine.status()\n"
+            "        health = self.target.health_summary()\n"
+            "        history = self.store.history(120.0)\n"
+            "        return alerts, health, history\n"
+        ),
+    })
+    result = _analyze_fixture(tmp_path, select=["MV102"])
+    hits = sorted(
+        (f.path, f.line, f.symbol) for f in result.active
+    )
+    assert hits == [
+        ("pkg/bad_recorder.py", 4, "sleep"),
+        ("pkg/bad_recorder.py", 5, "score_texts"),
+        ("pkg/bad_recorder.py", 8, "pack_token_budget"),
+    ], hits

@@ -631,8 +631,8 @@ def test_read_jsonl_tolerates_live_concurrent_writer(tmp_path):
 _REPORT_JSON_KEYS = {
     "schema", "run_dir", "generated_wall", "events", "heartbeat", "spans",
     "counters", "gauges", "histograms", "derived", "latency_decomposition",
-    "cascade", "fleet", "autoscaler", "replicas", "shards", "programs",
-    "roofline",
+    "cascade", "fleet", "autoscaler", "alerts", "incidents", "replicas",
+    "shards", "programs", "roofline",
 }
 
 

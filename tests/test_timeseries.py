@@ -1,0 +1,670 @@
+"""The post-hoc "what happened" plane (PR 18, docs/observability.md
+"Metrics history" / "Alert rules" / "Incident bundles"):
+telemetry/timeseries.py + telemetry/alerts.py + serving/incident.py.
+
+The acceptance contract this file pins:
+
+* **TSDB semantics** — counters enter as derived per-second rates
+  (clamped at 0 across resets), gauges as-is, histogram summaries as
+  `<name>.<field>` series; points coalesce within one resolution
+  bucket and every ring is retention-bounded; labeled snapshot parts
+  render Prometheus-style series names.
+* **sampler under fire** — a live concurrent registry writer never
+  breaks a sample (`tsdb.sample_errors` stays 0) and history only
+  grows — the live twin of the events.jsonl torn-tail test.
+* **alert edges** — rules fire and resolve exactly once per
+  transition (`alert.fired`/`alert.resolved` counters, `alert.firing`
+  gauge, listener calls), and the `alert.*` gauges round-trip the
+  Prometheus exposition like every other metric.
+* **incident bundles** — triggers are non-blocking and rate-limited;
+  a bundle carries manifest + metric window + traces + programs, all
+  atomic; retention prunes oldest-first; the `incident.dump` fault
+  point proves a failing or slow dump never delays request
+  resolution; a replica SIGKILL under load produces a bundle
+  automatically and `telemetry-report` renders it (text and --json).
+* **disabled is free** — `attach_flight_recorder` with cadence 0
+  constructs nothing and adds no metric names; with the sampler ON,
+  served scores are bitwise-unchanged.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from memvul_tpu import telemetry
+from memvul_tpu.resilience import faults
+from memvul_tpu.serving import (
+    STATUS_OK,
+    InprocessClient,
+    Replica,
+    ReplicaRouter,
+    RouterConfig,
+    ScoringService,
+    ServiceConfig,
+)
+from memvul_tpu.serving.frontend import run_http_server
+from memvul_tpu.serving.incident import (
+    BUNDLE_FILES,
+    IncidentRecorder,
+    attach_flight_recorder,
+)
+from memvul_tpu.telemetry.alerts import AlertEngine, AlertRule, default_rules
+from memvul_tpu.telemetry.registry import TelemetryRegistry
+from memvul_tpu.telemetry.timeseries import (
+    MetricsSampler,
+    TimeSeriesStore,
+    series_name,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.reset()
+    telemetry.reset()
+
+
+def _part(counters=None, gauges=None, histograms=None, labels=None):
+    return [(
+        labels or {},
+        {
+            "counters": counters or {},
+            "gauges": gauges or {},
+            "histograms": histograms or {},
+        },
+    )]
+
+
+# -- TimeSeriesStore -----------------------------------------------------------
+
+def test_store_derives_counter_rates_and_keeps_gauges():
+    store = TimeSeriesStore(resolution_s=1.0, retention_s=60.0)
+    store.observe(_part(counters={"serve.errors": 0},
+                        gauges={"serve.queue_depth": 1.0}), now=100.0)
+    # first counter sample establishes the baseline — no rate point yet
+    assert "serve.errors.rate" not in store.history(now=100.0)
+    store.observe(_part(counters={"serve.errors": 5},
+                        gauges={"serve.queue_depth": 3.0}), now=101.0)
+    store.observe(_part(counters={"serve.errors": 5}), now=102.0)
+    # a counter RESET (restart) clamps to 0, never a negative rate
+    store.observe(_part(counters={"serve.errors": 2}), now=103.0)
+    history = store.history(now=103.0)
+    assert history["serve.errors.rate"] == [
+        [101.0, 5.0], [102.0, 0.0], [103.0, 0.0]
+    ]
+    assert history["serve.queue_depth"] == [[100.0, 1.0], [101.0, 3.0]]
+
+
+def test_store_histogram_summaries_become_field_series():
+    store = TimeSeriesStore()
+    store.observe(_part(histograms={
+        "serve.latency_s": {"count": 4, "mean": 0.2, "p50": 0.15, "p95": 0.4},
+    }), now=50.0)
+    history = store.history(now=50.0)
+    assert history["serve.latency_s.mean"] == [[50.0, 0.2]]
+    assert history["serve.latency_s.p50"] == [[50.0, 0.15]]
+    assert history["serve.latency_s.p95"] == [[50.0, 0.4]]
+
+
+def test_store_coalesces_within_resolution_and_bounds_retention():
+    store = TimeSeriesStore(resolution_s=1.0, retention_s=5.0)
+    # two samples inside one bucket: newest value, the bucket's timestamp
+    store.observe(_part(gauges={"g": 1.0}), now=10.0)
+    store.observe(_part(gauges={"g": 2.0}), now=10.4)
+    assert store.history(now=10.4)["g"] == [[10.0, 2.0]]
+    # rings hold at most retention/resolution points regardless of feed
+    for i in range(20):
+        store.observe(_part(gauges={"g": float(i)}), now=20.0 + i)
+    points = store.history(now=40.0)["g"]
+    assert len(points) == 5  # maxlen = 5/1
+    assert points[-1] == [39.0, 19.0]
+
+
+def test_store_labels_window_and_prefix_filter():
+    store = TimeSeriesStore()
+    store.observe(
+        _part(gauges={"serve.queue_depth": 2.0}, labels={"replica": "r0"})
+        + _part(gauges={"serve.queue_depth": 7.0}, labels={"replica": "r1"})
+        + _part(gauges={"slo.burn_rate_fast": 0.5}),
+        now=100.0,
+    )
+    assert series_name("m", (("replica", "r0"),)) == 'm{replica="r0"}'
+    history = store.history(metric="serve.", now=100.0)
+    assert set(history) == {
+        'serve.queue_depth{replica="r0"}', 'serve.queue_depth{replica="r1"}'
+    }
+    # window(): exact-name justification slice, all label sets
+    window = store.window(["serve.queue_depth"], 60.0, now=100.0)
+    assert window['serve.queue_depth{replica="r1"}'] == [[100.0, 7.0]]
+    assert "slo.burn_rate_fast" not in window
+    # and the window is a cutoff, not the whole ring
+    store.observe(_part(gauges={"slo.burn_rate_fast": 2.0}), now=500.0)
+    assert store.window(["slo.burn_rate_fast"], 10.0, now=500.0) == {
+        "slo.burn_rate_fast": [[500.0, 2.0]]
+    }
+
+
+def test_store_and_sampler_validation():
+    with pytest.raises(ValueError, match="resolution_s"):
+        TimeSeriesStore(resolution_s=0)
+    with pytest.raises(ValueError, match="retention_s"):
+        TimeSeriesStore(resolution_s=2.0, retention_s=1.0)
+    with pytest.raises(ValueError, match="cadence_s"):
+        MetricsSampler(TelemetryRegistry(enabled=True), cadence_s=0)
+
+
+# -- MetricsSampler ------------------------------------------------------------
+
+def test_sampler_reports_own_cost_and_samples_bare_registry():
+    target = TelemetryRegistry(enabled=True)
+    meter = TelemetryRegistry(enabled=True)
+    target.gauge("serve.queue_depth").set(4.0)
+    sampler = MetricsSampler(target, cadence_s=1.0, registry=meter, start=False)
+    sampler.sample(now=100.0)
+    assert sampler.history()["serve.queue_depth"] == [[100.0, 4.0]]
+    snap = meter.snapshot()
+    assert snap["counters"]["tsdb.samples"] == 1
+    assert "tsdb.sample_errors" not in snap["counters"]
+    assert snap["gauges"]["tsdb.series"] >= 1
+    assert snap["histograms"]["tsdb.sample_s"]["count"] == 1
+    status = sampler.status()
+    assert status["enabled"] is True and status["samples"] == 1
+
+
+def test_sampler_survives_live_concurrent_registry_writer():
+    """The live twin of the events.jsonl torn-tail test: a writer thread
+    hammers the registry while the sampler snapshots it — every sample
+    succeeds, rates never go negative, history only grows."""
+    target = TelemetryRegistry(enabled=True)
+    meter = TelemetryRegistry(enabled=True)
+    sampler = MetricsSampler(target, cadence_s=1.0, registry=meter, start=False)
+    stop = threading.Event()
+
+    def writer():
+        for i in range(400):
+            target.counter("load.ticks").inc()
+            target.gauge("load.depth").set(float(i))
+            target.histogram("load.lat_s").observe(0.001 * (i % 7))
+            time.sleep(0.0003)
+        stop.set()
+
+    thread = threading.Thread(target=writer)
+    thread.start()
+    samples = 0
+    seen = 0
+    try:
+        while not stop.is_set():
+            sampler.sample()  # must never raise
+            samples += 1
+            points = sampler.history().get("load.depth", [])
+            assert len(points) >= seen, "history went backwards"
+            seen = len(points)
+    finally:
+        thread.join(timeout=10)
+    assert samples > 10, "the sampler never actually raced the writer"
+    assert "tsdb.sample_errors" not in meter.snapshot()["counters"]
+    for point in sampler.history().get("load.ticks.rate", []):
+        assert point[1] >= 0.0
+
+
+# -- AlertEngine ---------------------------------------------------------------
+
+def test_alert_rule_validation_and_default_set():
+    rules = default_rules()
+    assert {r.name for r in rules} == {
+        "serve_error_rate", "dead_letter_streak", "heartbeat_stalled",
+        "hbm_growth", "recompile_after_warm", "slo_fast_burn",
+    }
+    with pytest.raises(ValueError, match="unknown kind"):
+        AlertRule("x", "weird", "m")
+    with pytest.raises(ValueError, match="needs a metric"):
+        AlertRule("x", "rate")
+    with pytest.raises(ValueError, match="window_s"):
+        AlertRule("x", "threshold", "m", window_s=0)
+    store = TimeSeriesStore()
+    rule = AlertRule("dup", "threshold", "m")
+    with pytest.raises(ValueError, match="duplicate"):
+        AlertEngine(store, rules=[rule, rule], start=False)
+
+
+def test_alert_engine_fires_and_resolves_once_per_edge():
+    store = TimeSeriesStore(resolution_s=1.0, retention_s=600.0)
+    meter = TelemetryRegistry(enabled=True)
+    rule = AlertRule("err_rate", "rate", "serve.errors",
+                     threshold=0.0, window_s=60.0)
+    engine = AlertEngine(store, registry=meter, rules=[rule], start=False)
+    heard = []
+    engine.add_listener(heard.append)
+
+    store.observe(_part(counters={"serve.errors": 0}), now=100.0)
+    store.observe(_part(counters={"serve.errors": 5}), now=101.0)
+    status = engine.tick(now=101.0)
+    assert [f["rule"] for f in status["firing"]] == ["err_rate"]
+    assert heard and heard[0]["rule"] == "err_rate"
+    assert heard[0]["value"] == 5.0
+    assert heard[0]["series"] == "serve.errors.rate"
+    assert heard[0]["rule_kind"] == "rate"  # never the event's own "kind"
+    # still firing: no duplicate edge
+    engine.tick(now=102.0)
+    snap = meter.snapshot()
+    assert snap["counters"]["alert.fired"] == 1
+    assert snap["gauges"]["alert.firing"] == 1.0
+    assert len(heard) == 1
+    # the offending points age out of the window → one resolve edge
+    engine.tick(now=300.0)
+    snap = meter.snapshot()
+    assert snap["counters"]["alert.resolved"] == 1
+    assert snap["gauges"]["alert.firing"] == 0.0
+    assert not engine.status()["firing"]
+    rules = {r["name"]: r for r in engine.status()["rules"]}
+    assert rules["err_rate"]["firing"] is False
+
+
+def test_alert_threshold_absence_and_growth_kinds():
+    store = TimeSeriesStore()
+    meter = TelemetryRegistry(enabled=True)
+    engine = AlertEngine(
+        store, registry=meter, start=False,
+        rules=[
+            AlertRule("burn", "threshold", "slo.burn_rate_fast",
+                      threshold=1.0, window_s=60.0),
+            AlertRule("stall", "absence", window_s=30.0),
+            AlertRule("leak", "growth", "serve.hbm_in_use_bytes",
+                      threshold=0.2, window_s=600.0),
+        ],
+    )
+    t0 = engine._started_wall
+    # grace: an empty store is not an absence until window_s after birth
+    assert not engine.tick(now=t0 + 1.0)["firing"]
+    status = engine.tick(now=t0 + 31.0)
+    assert [f["rule"] for f in status["firing"]] == ["stall"]
+    # samples arrive: absence resolves; burn + leak fire on their shapes
+    store.observe(_part(gauges={"slo.burn_rate_fast": 0.4,
+                                "serve.hbm_in_use_bytes": 1000.0}),
+                  now=t0 + 32.0)
+    store.observe(_part(gauges={"slo.burn_rate_fast": 2.5,
+                                "serve.hbm_in_use_bytes": 1300.0}),
+                  now=t0 + 40.0)
+    status = engine.tick(now=t0 + 40.0)
+    assert {f["rule"] for f in status["firing"]} == {"burn", "leak"}
+    leak = next(f for f in status["firing"] if f["rule"] == "leak")
+    assert leak["value"] == pytest.approx(0.3)
+
+
+def test_alert_gauges_roundtrip_exposition():
+    """The new alert.* names ride the same Prometheus exposition as
+    every other metric — render and parse agree exactly."""
+    from memvul_tpu.telemetry.exposition import (
+        parse_exposition, render_exposition,
+    )
+
+    registry = TelemetryRegistry(enabled=True)
+    registry.counter("alert.fired").inc(3)
+    registry.counter("alert.resolved").inc(2)
+    registry.gauge("alert.firing").set(1.0)
+    registry.gauge("tsdb.series").set(42.0)
+    text = render_exposition([({}, registry.snapshot())])
+    parsed = parse_exposition(text)
+    assert parsed["alert_fired"][""] == 3
+    assert parsed["alert_resolved"][""] == 2
+    assert parsed["alert_firing"][""] == 1.0
+    assert parsed["tsdb_series"][""] == 42.0
+
+
+# -- IncidentRecorder ----------------------------------------------------------
+
+class _Target:
+    """Minimal bundle-snapshot surface."""
+
+    def __init__(self):
+        self.hold = None  # optional Event: health_summary blocks on it
+
+    def health_summary(self):
+        if self.hold is not None:
+            assert self.hold.wait(timeout=30), "test forgot to release hold"
+        return {"status": "ok", "queue_depth": 0}
+
+    def recent_traces(self, limit=None):
+        return [{"trace_id": "t-1"}]
+
+    def programs_snapshot(self):
+        return [{"key": "score:4x8"}]
+
+
+def _recorder(tmp_path, meter, **kw):
+    store = TimeSeriesStore()
+    store.observe(_part(gauges={"serve.queue_depth": 1.0}), now=time.time())
+    kw.setdefault("start", False)
+    return IncidentRecorder(
+        _Target(), tmp_path, store=store, registry=meter, **kw
+    )
+
+
+def test_incident_bundle_contents_and_rate_limit(tmp_path):
+    meter = TelemetryRegistry(enabled=True)
+    recorder = _recorder(tmp_path, meter, min_interval_s=3600.0)
+    assert recorder.trigger("replica_dead", {"replica": "r0"}) is True
+    assert recorder.drain() == 1
+    bundles = list((tmp_path / "incidents").iterdir())
+    assert len(bundles) == 1 and bundles[0].name.endswith("-replica_dead")
+    assert sorted(p.name for p in bundles[0].iterdir()) == sorted(BUNDLE_FILES)
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    assert manifest["trigger"] == "replica_dead"
+    assert manifest["detail"] == {"replica": "r0"}
+    assert manifest["health"]["status"] == "ok"
+    metrics = json.loads((bundles[0] / "metrics.json").read_text())
+    assert "serve.queue_depth" in metrics["history"]
+    assert json.loads((bundles[0] / "traces.json").read_text()) == [
+        {"trace_id": "t-1"}
+    ]
+    assert json.loads((bundles[0] / "programs.json").read_text()) == [
+        {"key": "score:4x8"}
+    ]
+    snap = meter.snapshot()
+    assert snap["counters"]["incident.dumps"] == 1
+    # a second trigger inside min_interval_s is suppressed, not written
+    recorder.trigger("replica_dead", {"replica": "r1"})
+    assert recorder.drain() == 1
+    assert len(list((tmp_path / "incidents").iterdir())) == 1
+    assert meter.snapshot()["counters"]["incident.suppressed"] == 1
+    assert recorder.status()["bundles"] == [bundles[0].name]
+
+
+def test_incident_retention_prunes_and_queue_bounds(tmp_path):
+    meter = TelemetryRegistry(enabled=True)
+    recorder = _recorder(tmp_path, meter, min_interval_s=0.0, max_bundles=2)
+    for i in range(4):
+        recorder.trigger(f"t{i}")
+    assert recorder.drain() == 4
+    names = sorted(p.name for p in (tmp_path / "incidents").iterdir())
+    assert len(names) == 2  # oldest pruned
+    assert names == recorder.status()["bundles"]
+    # bounded queue: overflow is a False return + a counter, never a block
+    tight = _recorder(tmp_path / "q", meter, queue_size=1)
+    assert tight.trigger("a") is True
+    assert tight.trigger("b") is False
+    assert meter.snapshot()["counters"]["incident.suppressed"] >= 1
+
+
+def test_incident_on_alert_listener_adapter(tmp_path):
+    meter = TelemetryRegistry(enabled=True)
+    recorder = _recorder(tmp_path, meter)
+    recorder.on_alert({"rule": "slo_fast_burn", "value": 2.0})
+    assert recorder.drain() == 1
+    (bundle,) = (tmp_path / "incidents").iterdir()
+    assert bundle.name.endswith("-alert-slo_fast_burn")
+    manifest = json.loads((bundle / "manifest.json").read_text())
+    assert manifest["detail"]["rule"] == "slo_fast_burn"
+
+
+def test_incident_dump_fault_is_counted_never_raised(tmp_path):
+    """The incident.dump fault point (docs/fault_tolerance.md): a
+    failing dump books incident.dump_errors and writes nothing — the
+    trigger side never sees the failure."""
+    meter = TelemetryRegistry(enabled=True)
+    recorder = _recorder(tmp_path, meter, min_interval_s=0.0)
+    faults.configure("incident.dump=raise:RuntimeError:dump chaos")
+    assert recorder.trigger("host_dead") is True
+    assert recorder.drain() == 1  # handled, not raised
+    snap = meter.snapshot()
+    assert snap["counters"]["incident.dump_errors"] == 1
+    assert "incident.dumps" not in snap["counters"]
+    assert not (tmp_path / "incidents").exists()
+    # the disarmed point recovers on the next trigger
+    recorder.trigger("host_dead")
+    assert recorder.drain() == 1
+    assert meter.snapshot()["counters"]["incident.dumps"] == 1
+
+
+# -- the serving path stays decoupled ------------------------------------------
+
+def _fake_service(registry=None, **overrides):
+    # the fake-predictor service from the router suite, at test scale
+    from test_serving_router import _FakePredictor
+
+    config = ServiceConfig(
+        max_batch=4, max_wait_ms=1.0, max_queue=1000,
+        default_deadline_ms=30000.0, **overrides,
+    )
+    return ScoringService(_FakePredictor(), config=config, registry=registry)
+
+
+@pytest.mark.chaos
+def test_slow_or_failing_dump_never_blocks_request_resolution(tmp_path):
+    """The off-path claim, chaos-tested: with the recorder's worker WEDGED
+    mid-dump (health_summary blocked) and a failing dump queued behind
+    it, client requests keep resolving at full speed."""
+    registry = TelemetryRegistry(enabled=True)
+    service = _fake_service(registry=registry)
+    # wedge: the worker blocks inside _dump reading the target's
+    # health_summary — the serving path shares only the trigger side
+    wedged = _Target()
+    hold = wedged.hold = threading.Event()
+    recorder = IncidentRecorder(
+        wedged, tmp_path, registry=registry,
+        min_interval_s=0.0, start=True,
+    )
+    service.incident_recorder = recorder
+    try:
+        assert recorder.trigger("wedge") is True
+        time.sleep(0.05)  # let the worker pick it up and block
+        client = InprocessClient(service)
+        t0 = time.perf_counter()
+        responses = [client.score(f"report {i}") for i in range(16)]
+        elapsed = time.perf_counter() - t0
+        assert all(r["status"] == STATUS_OK for r in responses)
+        assert elapsed < 5.0, "scoring stalled behind a wedged dump"
+        assert "incident.dumps" not in registry.snapshot()["counters"]
+    finally:
+        hold.set()
+        recorder.stop()
+        service.drain()
+    # released: the wedged bundle completes after the fact
+    assert (tmp_path / "incidents").is_dir()
+
+
+def test_attach_gate_constructs_nothing_when_disabled(tmp_path):
+    """Disabled is free: cadence 0 returns the target untouched — no
+    attributes, no threads, and (the byte-identical pin) no new metric
+    names in the service's own emitted set."""
+    registry = TelemetryRegistry(enabled=True)
+    service = _fake_service(registry=registry)
+    client = InprocessClient(service)
+    try:
+        assert attach_flight_recorder(
+            service, run_dir=tmp_path, registry=registry, cadence_s=0.0
+        ) is service
+        for attr in ("metrics_sampler", "alert_engine", "incident_recorder"):
+            assert not hasattr(service, attr)
+        assert client.score("probe")["status"] == STATUS_OK
+    finally:
+        service.drain()
+    names = set(registry.snapshot()["counters"]) | set(
+        registry.snapshot()["gauges"]
+    )
+    assert not [n for n in names
+                if n.startswith(("tsdb.", "alert.", "incident."))], names
+    assert not (tmp_path / "incidents").exists()
+
+
+def test_attach_enabled_wires_plane_and_scores_stay_bitwise(tmp_path):
+    """With the sampler ON, responses are bitwise-identical to the
+    undisturbed service — the history plane observes, never perturbs."""
+    texts = [f"report {i}" for i in range(12)]
+    plain = _fake_service()
+    baseline = [InprocessClient(plain).score(t) for t in texts]
+    plain.drain()
+
+    registry = TelemetryRegistry(enabled=True)
+    service = _fake_service(registry=registry)
+    attach_flight_recorder(
+        service, run_dir=tmp_path, registry=registry,
+        cadence_s=0.02, alert_interval_s=3600.0, rules=(),
+    )
+    try:
+        assert service.metrics_sampler.cadence_s == 0.02
+        assert service.alert_engine is not None
+        assert service.incident_recorder is not None
+        responses = [InprocessClient(service).score(t) for t in texts]
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and not service.metrics_sampler.store.series_count):
+            time.sleep(0.01)
+        assert service.metrics_sampler.store.series_count > 0
+    finally:
+        service.metrics_sampler.stop()
+        service.alert_engine.stop()
+        service.incident_recorder.stop()
+        service.drain()
+    for base, live in zip(baseline, responses):
+        assert base["status"] == live["status"] == STATUS_OK
+        assert base["predict"] == live["predict"]  # bitwise via JSON floats
+        assert base["anchor"] == live["anchor"]
+
+
+# -- HTTP surfaces -------------------------------------------------------------
+
+def _get_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_frontend_metricsz_and_alertz(tmp_path):
+    registry = TelemetryRegistry(enabled=True)
+    service = _fake_service(registry=registry)
+    server = run_http_server(service, port=0)
+    base = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        # disabled: a probe can tell "off" from "wrong URL"
+        status, body = _get_json(base, "/metricsz")
+        assert status == 200 and body == {
+            "enabled": False, "series": 0, "history": {}
+        }
+        status, body = _get_json(base, "/alertz")
+        assert status == 200 and body == {
+            "enabled": False, "firing": [], "rules": []
+        }
+        # enabled: attach the plane and scrape history + rules
+        sampler = MetricsSampler(
+            service, cadence_s=1.0, registry=registry, start=False
+        )
+        sampler.store.observe(
+            _part(gauges={"serve.queue_depth": 2.0}), now=time.time()
+        )
+        sampler.sample()
+        service.metrics_sampler = sampler
+        service.alert_engine = AlertEngine(
+            sampler.store, registry=registry, start=False
+        )
+        status, body = _get_json(base, "/metricsz?window=600")
+        assert status == 200 and body["enabled"] is True
+        assert "serve.queue_depth" in body["history"]
+        status, body = _get_json(
+            base, "/metricsz?metric=serve.queue_depth"
+        )
+        assert list(body["history"]) == ["serve.queue_depth"]
+        status, body = _get_json(base, "/alertz")
+        assert status == 200 and body["enabled"] is True
+        assert {r["name"] for r in body["rules"]} == {
+            r.name for r in default_rules()
+        }
+        # a non-numeric window is a 400, not a stack trace
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(base, "/metricsz?window=soon")
+        assert err.value.code == 400
+    finally:
+        server.shutdown()
+        service.drain()
+
+
+# -- the acceptance drill ------------------------------------------------------
+
+@pytest.mark.chaos
+def test_replica_sigkill_under_load_produces_bundle_and_report(
+    tmp_path, capsys
+):
+    """ISSUE 18's acceptance drill: SIGKILL a replica under load with the
+    plane on → an incident bundle appears automatically carrying the
+    metric history window, the trace ring, active alerts, and fleet
+    state — and telemetry-report renders it, text and --json."""
+    from test_serving_router import _FakePredictor
+
+    run_dir = tmp_path / "run"
+    registry = telemetry.configure(run_dir=run_dir)
+
+    def make_factory(i):
+        def factory(reg):
+            return ScoringService(
+                _FakePredictor(),
+                config=ServiceConfig(
+                    max_batch=4, max_wait_ms=1.0, max_queue=1000,
+                    default_deadline_ms=30000.0, trace_sample_rate=1.0,
+                ),
+                registry=reg,
+            )
+        return factory
+
+    replicas = [
+        Replica(i, make_factory(i), telemetry_enabled=True) for i in range(2)
+    ]
+    router = ReplicaRouter(
+        replicas,
+        config=RouterConfig(monitor_interval_s=0.05, max_reroutes=3),
+    )
+    attach_flight_recorder(
+        router, run_dir=run_dir, registry=registry,
+        cadence_s=0.02, alert_interval_s=3600.0, rules=(),
+        min_interval_s=0.0,
+    )
+    try:
+        warm = [router.submit(f"warm {i}").result(timeout=10) for i in range(8)]
+        assert all(r["status"] == STATUS_OK for r in warm)
+        faults.configure("replica.kill.replica-0=raise:RuntimeError:chaos kill")
+        responses = [
+            router.submit(f"post-kill {i}").result(timeout=15)
+            for i in range(24)
+        ]
+        assert all(r["status"] == STATUS_OK for r in responses)
+        deadline = time.monotonic() + 15
+        incidents = run_dir / "incidents"
+        while time.monotonic() < deadline and not (
+            incidents.is_dir() and any(incidents.iterdir())
+        ):
+            time.sleep(0.02)
+        bundles = sorted(incidents.iterdir())
+        assert bundles, "no incident bundle after a replica SIGKILL"
+        assert bundles[0].name.endswith("-replica_dead")
+        manifest = json.loads((bundles[0] / "manifest.json").read_text())
+        assert manifest["detail"]["replica"] == "replica-0"
+        assert manifest["health"]["replicas"]  # fleet state froze in
+        assert "firing" in manifest["alerts"]  # active-alert snapshot
+        metrics = json.loads((bundles[0] / "metrics.json").read_text())
+        assert metrics["history"], "bundle carries no metric history"
+        assert any("replica" in name for name in metrics["history"])
+        traces = json.loads((bundles[0] / "traces.json").read_text())
+        assert traces, "bundle carries no trace ring"
+    finally:
+        router.metrics_sampler.stop()
+        router.alert_engine.stop()
+        router.incident_recorder.stop()
+        router.drain()
+        telemetry.reset()
+
+    # the flight recorder's output is renderable, text and --json
+    from memvul_tpu.__main__ import main
+
+    assert main(["telemetry-report", str(run_dir)]) == 0
+    text = capsys.readouterr().out
+    assert "INCIDENTS" in text and "replica_dead" in text
+    assert main(["telemetry-report", str(run_dir), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["incidents"], payload.get("incidents")
+    incident = payload["incidents"][0]
+    assert incident["trigger"] == "replica_dead"
+    assert incident["series"] > 0 and incident["traces"] > 0
