@@ -393,6 +393,7 @@ def cmd_serve(args) -> int:
                 use_mesh=not args.no_mesh,
                 replicas=args.replicas,
                 tsdb_cadence=args.tsdb_cadence,
+                tenants=args.tenants,
             )
     except ValueError as e:
         print(f"serve: {e}", file=sys.stderr)
@@ -446,6 +447,21 @@ def cmd_bench(args) -> int:
 
 # -- anchor-bank lifecycle (bankops/, docs/anchor_bank.md) ---------------------
 
+def _bank_store(args):
+    """The subcommand's :class:`~memvul_tpu.bankops.store.BankStore`.
+    ``--tenant NAME`` scopes the root to ``<store>/<tenant>`` — the
+    per-org layout ``serve --tenants`` points at (docs/multitenancy.md),
+    so one ``--store`` root holds every org's versioned bank."""
+    from .bankops import BankStore
+
+    tenant = getattr(args, "tenant", None)
+    if not tenant:
+        return BankStore(args.store)
+    from .serving.tenancy import validate_tenant_name
+
+    return BankStore(Path(args.store) / validate_tenant_name(tenant))
+
+
 def _bank_predictor(args):
     """A warmed serving-shaped predictor over an archive — what the
     shadow/promote subcommands score candidate banks through."""
@@ -479,10 +495,9 @@ def _bank_predictor(args):
 def cmd_bank_build(args) -> int:
     """Commit an anchor set (the ``build-data`` output JSON) as a root
     store version."""
-    from .bankops import BankStore
     from .data.cwe import load_anchors
 
-    store = BankStore(args.store)
+    store = _bank_store(args)
     manifest = store.create(
         load_anchors(args.anchors), source=args.source, note=args.note
     )
@@ -493,9 +508,9 @@ def cmd_bank_build(args) -> int:
 def cmd_bank_diff(args) -> int:
     """Derive a new version from a parent via add/retire/reweight/edit
     ops (``--ops`` JSON plus the repeatable conveniences)."""
-    from .bankops import BankDiff, BankStore, BankStoreError
+    from .bankops import BankDiff, BankStoreError
 
-    store = BankStore(args.store)
+    store = _bank_store(args)
     ops = []
     if args.ops:
         raw = args.ops
@@ -525,9 +540,7 @@ def cmd_bank_diff(args) -> int:
 def cmd_bank_log(args) -> int:
     """Lineage of a version (default: latest), root first, plus the
     ACTIVE pointer."""
-    from .bankops import BankStore
-
-    store = BankStore(args.store)
+    store = _bank_store(args)
     print(json.dumps({
         "versions": store.versions(),
         "active": store.active(),
@@ -540,9 +553,9 @@ def cmd_bank_shadow(args) -> int:
     """Offline shadow: replay a journaled ``predict_file`` output
     against a candidate store version; writes ``shadow_deltas.jsonl``
     and prints the gate-consumable summary."""
-    from .bankops import BankStore, replay_results
+    from .bankops import replay_results
 
-    store = BankStore(args.store)
+    store = _bank_store(args)
     predictor, reader = _bank_predictor(args)
     out_dir = Path(args.out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -572,10 +585,10 @@ def cmd_bank_promote(args) -> int:
     machine-readable decision; ``--apply`` additionally advances the
     store's ACTIVE pointer (a live fleet promotes in-process via
     ``bankops.promote``).  Exit 0 approved, 1 refused, 2 usage."""
-    from .bankops import BankStore, GateThresholds, evaluate_candidate
+    from .bankops import GateThresholds, evaluate_candidate
     from .bankops.store import BankStoreError
 
-    store = BankStore(args.store)
+    store = _bank_store(args)
     predictor, reader = _bank_predictor(args)
     shadow_summary = None
     if args.shadow_summary:
@@ -600,7 +613,10 @@ def cmd_bank_promote(args) -> int:
     except BankStoreError as e:
         print(f"bank promote: {e}", file=sys.stderr)
         return 2
-    store.record_promotion(kind="gate_decision", **decision.to_json())
+    store.record_promotion(
+        kind="gate_decision", tenant=getattr(args, "tenant", None),
+        **decision.to_json()
+    )
     if decision.approved and args.apply:
         store.set_active(args.candidate, source="promotion")
     print(json.dumps(decision.to_json(), indent=2))
@@ -856,6 +872,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "flight recorder (docs/observability.md); default: "
                    "the archive's telemetry.tsdb_cadence_s (0 = off, "
                    "nothing constructed)")
+    p.add_argument("--tenants", default=None, metavar="SPEC",
+                   help="multi-tenant bank plane: comma-separated "
+                   "name=store_dir pairs (e.g. orgA=banks/orgA,orgB="
+                   "banks/orgB); each org's ACTIVE bank version is "
+                   "installed at startup and requests carry a 'tenant' "
+                   "JSON field or X-MemVul-Tenant header (untagged = "
+                   "the archive's own bank; overrides the archive's "
+                   "serving.tenants; docs/multitenancy.md)")
     p.add_argument("--mesh", default=None)
     p.add_argument("--no-mesh", action="store_true")
     p.set_defaults(fn=cmd_serve)
@@ -879,6 +903,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="anchor JSON (e.g. CWE_anchor_golden_project.json)")
     b.add_argument("--source", default="build", help="provenance tag")
     b.add_argument("--note", default=None)
+    b.add_argument("--tenant", default=None, metavar="NAME",
+                   help="scope the store to <store>/<tenant> — the "
+                   "per-org layout serve --tenants points at "
+                   "(docs/multitenancy.md)")
     b.set_defaults(fn=cmd_bank_build)
 
     b = bank_sub.add_parser(
@@ -894,6 +922,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--reweight", action="append", metavar="CATEGORY=W",
                    help="reweight one category (repeatable)")
     b.add_argument("--note", default=None)
+    b.add_argument("--tenant", default=None, metavar="NAME",
+                   help="scope the store to <store>/<tenant> — the "
+                   "per-org layout serve --tenants points at "
+                   "(docs/multitenancy.md)")
     b.set_defaults(fn=cmd_bank_diff)
 
     b = bank_sub.add_parser(
@@ -901,6 +933,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     b.add_argument("--store", required=True)
     b.add_argument("version", nargs="?", default=None)
+    b.add_argument("--tenant", default=None, metavar="NAME",
+                   help="scope the store to <store>/<tenant> — the "
+                   "per-org layout serve --tenants points at "
+                   "(docs/multitenancy.md)")
     b.set_defaults(fn=cmd_bank_log)
 
     b = bank_sub.add_parser(
@@ -921,6 +957,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--split", default=None)
     b.add_argument("--threshold", type=float, default=0.5)
     b.add_argument("--overrides", default=None)
+    b.add_argument("--tenant", default=None, metavar="NAME",
+                   help="scope the store to <store>/<tenant> — the "
+                   "per-org layout serve --tenants points at "
+                   "(docs/multitenancy.md)")
     b.set_defaults(fn=cmd_bank_shadow)
 
     b = bank_sub.add_parser(
@@ -949,6 +989,10 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--max-flip-rate", type=float, default=0.02)
     b.add_argument("--min-shadow-samples", type=int, default=100)
     b.add_argument("--overrides", default=None)
+    b.add_argument("--tenant", default=None, metavar="NAME",
+                   help="scope the store to <store>/<tenant> — the "
+                   "per-org layout serve --tenants points at "
+                   "(docs/multitenancy.md)")
     b.set_defaults(fn=cmd_bank_promote)
 
     p = sub.add_parser(

@@ -24,6 +24,18 @@ delegating shim).  Four class families, wherever they live:
   applies: a trigger is a bounded-queue put and a dump reads
   snapshots; a recorder that scored or slept inline would couple the
   post-mortem plane to the request path it exists to observe;
+* classes named ``*Cache`` (or deriving from one;
+  serving/admission_cache.py) — the admission cache sits ON the
+  request hot path (every submit probes it), so a lookup/store must be
+  a dict probe under a short lock and nothing else: a cache that
+  encoded, scored, or slept inline would cost every request what it
+  exists to save the occasional duplicate;
+* classes named ``*Tenant*`` (name or base contains ``Tenant``;
+  serving/tenancy.py) — tenant managers resolve names to stores and
+  record liveness; installing banks, encoding, and fleet rollouts are
+  the module-level ``configure_tenants``/``promote_tenant`` helpers'
+  job, so a manager method that swapped or scored inline would smuggle
+  control-plane work onto whatever thread asked for a lookup;
 * classes named ``*Dispatcher`` (or deriving from one;
   serving/dispatch.py) — the batcher strategies themselves.  Their JOB
   is to encode, pack, and score, so the serving-surface names stay
@@ -127,6 +139,23 @@ def _is_recorder_class(node: ast.ClassDef) -> bool:
     return any(_base_name(b).endswith("Recorder") for b in node.bases)
 
 
+def _is_cache_class(node: ast.ClassDef) -> bool:
+    # the admission cache (serving/admission_cache.py) is probed on
+    # every submit: lookup/store are dict ops under a short lock, never
+    # encoding/scoring/sleeping
+    if node.name.endswith("Cache"):
+        return True
+    return any(_base_name(b).endswith("Cache") for b in node.bases)
+
+
+def _is_tenant_class(node: ast.ClassDef) -> bool:
+    # tenant managers (serving/tenancy.py) resolve names to stores —
+    # selection only; installs/rollouts live in module-level helpers
+    if "Tenant" in node.name:
+        return True
+    return any("Tenant" in _base_name(b) for b in node.bases)
+
+
 def _is_dispatcher_class(node: ast.ClassDef) -> bool:
     if node.name.endswith("Dispatcher"):
         return True
@@ -150,13 +179,16 @@ def check(ctx: AnalysisContext) -> Iterator[Finding]:
                 or _is_router_class(node)
                 or _is_balancer_class(node)
                 or _is_recorder_class(node)
+                or _is_cache_class(node)
+                or _is_tenant_class(node)
             ):
                 forbidden = FORBIDDEN_NAMES
                 contract = (
                     "a handler may only submit() and wait on the future; "
                     "a router/balancer/autoscaler may only select from "
                     "cached state; a recorder may only enqueue triggers "
-                    "and dump snapshots"
+                    "and dump snapshots; a cache may only probe its map; "
+                    "a tenant manager may only resolve names"
                 )
             elif _is_dispatcher_class(node):
                 forbidden = DISPATCHER_FORBIDDEN_NAMES

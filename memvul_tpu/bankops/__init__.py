@@ -49,6 +49,7 @@ from .promote import (  # noqa: F401
     evaluate_candidate,
     evaluate_cascade,
     evaluate_gate,
+    evaluate_reweight,
     golden_metrics,
     promote,
 )

@@ -256,6 +256,90 @@ def evaluate_cascade(
     )
 
 
+def evaluate_reweight(
+    predictor,
+    store: BankStore,
+    version: str,
+    eval_instances: Iterable[Dict],
+    shadow_summary: Optional[Dict[str, Any]] = None,
+    thresholds: Optional[GateThresholds] = None,
+    threshold: float = 0.5,
+) -> PromotionDecision:
+    """Parity gate for per-anchor reweighting (docs/multitenancy.md):
+    the golden set is scored ONCE through a store version's warmed
+    bank, then judged twice from the same probability matrix — the
+    plain ``argmax`` selection as "active" and the weighted selection
+    (``argmax(probs * weights)``, weights from each anchor instance's
+    ``meta["weight"]``, default 1.0) as "candidate".  The candidate's
+    per-text score is the RAW probability of the weighted winner —
+    exactly what the serving path reports (serving/dispatch.py), so the
+    gate measures precisely the decision change a tenant would see.
+
+    A bank whose weights are all 1.0 selects identically by
+    construction: zero flips, identical metrics, approved — the parity
+    anchor the reweight tests pin.  Skewed weights show up as decision
+    flips and refuse through the standard machine-readable
+    ``{code, observed, limit}`` records of :func:`evaluate_gate`."""
+    bank_instances = store.instances(version)
+    bank, _labels, n_anchors = predictor.encode_bank(bank_instances)
+    predictor.warmup_bank_shapes(bank)
+    raw = [
+        float((inst.get("meta") or {}).get("weight", 1.0))
+        for inst in bank_instances
+    ]
+    if len(raw) != int(n_anchors):
+        raise BankStoreError(
+            f"bank {version}: {len(raw)} instances vs {n_anchors} anchors "
+            "— cannot align weights to anchor rows"
+        )
+    weights = np.asarray(raw, dtype=np.float32)
+    instances = list(eval_instances)
+    texts = [inst["text1"] for inst in instances]
+    metas = [inst.get("meta") or {} for inst in instances]
+    probs = score_texts(predictor, texts, bank, n_anchors)
+    probs = probs[:, :n_anchors] if len(instances) else probs
+
+    if instances:
+        best_active = probs.max(axis=-1)
+        # raw prob of the weighted winner — the served "score"
+        winners = (probs * weights[None, :]).argmax(axis=-1)
+        best_candidate = probs[np.arange(len(instances)), winners]
+    else:
+        best_active = best_candidate = np.zeros((0,))
+        winners = np.zeros((0,), dtype=np.int64)
+
+    def _measured(best) -> Dict[str, float]:
+        measure = SiameseMeasure()
+        measure.update(best, metas)
+        out = measure.compute(reset=True)
+        out["n_eval"] = float(len(instances))
+        return out
+
+    if shadow_summary is None and instances:
+        flips = int(
+            ((best_active >= threshold) != (best_candidate >= threshold)).sum()
+        )
+        deltas = np.abs(best_candidate - best_active)
+        shadow_summary = {
+            "sampled": len(instances),
+            "flips": flips,
+            "flip_rate": flips / len(instances),
+            "anchor_changes": int(
+                (probs.argmax(axis=-1) != winners).sum()
+            ),
+            "mean_abs_delta": float(deltas.mean()),
+            "max_abs_delta": float(deltas.max()),
+        }
+    return evaluate_gate(
+        _measured(best_active),
+        _measured(best_candidate),
+        shadow_summary,
+        thresholds=thresholds,
+        candidate=f"{version}+reweight",
+        parent=version,
+    )
+
+
 def evaluate_candidate(
     predictor,
     store: BankStore,
@@ -297,17 +381,27 @@ def evaluate_candidate(
     )
 
 
-def _install(target, instances: List[Dict], source: str, store_version: str) -> int:
+def _install(
+    target,
+    instances: List[Dict],
+    source: str,
+    store_version: str,
+    tenant: Optional[str] = None,
+) -> int:
     """Install a bank on a single service or roll it across a fleet —
-    the PR 6 path, so the no-torn-version invariant holds throughout."""
+    the PR 6 path, so the no-torn-version invariant holds throughout.
+    ``tenant`` scopes the install to one named tenant's bank slot
+    (serving/tenancy.py); ``None`` keeps the default-tenant path
+    byte-identical to the pre-tenancy behaviour."""
     if hasattr(target, "replicas"):
         from ..serving.router import rolling_swap
 
         return rolling_swap(
-            target, instances, source=source, store_version=store_version
+            target, instances, source=source, store_version=store_version,
+            tenant=tenant,
         )
     return target.swap_bank(
-        instances, source=source, store_version=store_version
+        instances, source=source, store_version=store_version, tenant=tenant
     )
 
 
@@ -316,16 +410,19 @@ def promote(
     store: BankStore,
     decision: PromotionDecision,
     registry=None,
+    tenant: Optional[str] = None,
 ) -> int:
     """Install an approved candidate into serving and advance the
     store's ``ACTIVE`` pointer + audit trail.  Raises
     :class:`PromotionRefused` (carrying the machine-readable decision)
     when the gate did not approve.  Returns the new serving bank
-    version number."""
+    version number.  ``tenant`` scopes the install (and the audit
+    record) to one named tenant's bank slot; other tenants' banks —
+    and the default bank — are untouched."""
     tel = registry if registry is not None else get_registry()
     if not decision.approved:
         store.record_promotion(
-            kind="promotion_refused", **decision.to_json()
+            kind="promotion_refused", tenant=tenant, **decision.to_json()
         )
         tel.counter("bank.promotions_refused").inc()
         raise PromotionRefused(decision)
@@ -336,6 +433,7 @@ def promote(
         store.instances(decision.candidate),
         source="promotion",
         store_version=decision.candidate,
+        tenant=tenant,
     )
     store.set_active(decision.candidate, source="promotion")
     store.record_promotion(
@@ -344,12 +442,14 @@ def promote(
         parent=decision.parent,
         serving_version=serving_version,
         reasons=decision.reasons,
+        tenant=tenant,
     )
     tel.counter("bank.promotions").inc()
     tel.event(
         "bank_promotion",
         candidate=decision.candidate,
         serving_version=serving_version,
+        tenant=tenant,
     )
     logger.info(
         "bank %s promoted to serving v%d", decision.candidate, serving_version
@@ -357,11 +457,14 @@ def promote(
     return serving_version
 
 
-def demote(target, store: BankStore, registry=None) -> Dict[str, Any]:
+def demote(
+    target, store: BankStore, registry=None, tenant: Optional[str] = None
+) -> Dict[str, Any]:
     """Roll serving back to the active store version's parent (the
     demote-to-parent rollback): install the parent bank through the
     same fleet path, repoint ``ACTIVE``, append the audit record.
-    Returns ``{"version": parent_id, "serving_version": int}``."""
+    Returns ``{"version": parent_id, "serving_version": int}``.
+    ``tenant`` scopes the rollback to one named tenant's bank slot."""
     tel = registry if registry is not None else get_registry()
     pointer = store.active()
     if pointer is None:
@@ -375,7 +478,7 @@ def demote(target, store: BankStore, registry=None) -> Dict[str, Any]:
         )
     serving_version = _install(
         target, store.instances(parent),
-        source="demotion", store_version=parent,
+        source="demotion", store_version=parent, tenant=tenant,
     )
     store.set_active(parent, source="demotion")
     store.record_promotion(
@@ -383,9 +486,10 @@ def demote(target, store: BankStore, registry=None) -> Dict[str, Any]:
         demoted=current,
         restored=parent,
         serving_version=serving_version,
+        tenant=tenant,
     )
     tel.counter("bank.demotions").inc()
-    tel.event("bank_demotion", demoted=current, restored=parent)
+    tel.event("bank_demotion", demoted=current, restored=parent, tenant=tenant)
     logger.info(
         "bank %s demoted — %s restored at serving v%d",
         current, parent, serving_version,

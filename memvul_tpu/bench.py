@@ -45,7 +45,11 @@ BENCH_MICRO_REQUESTS/BENCH_MICRO_CLIENTS set the load,
 BENCH_SERVE_MAX_BATCH/BENCH_SERVE_WAIT_MS the micro-batcher,
 BENCH_SERVE_IMPL the dispatch strategy (bucketed | ragged | continuous |
 cascade | ab — ab drives all four over one seeded schedule),
-BENCH_CASCADE_BAND="low,high" the cascade leg's fp32 rescue band;
+BENCH_CASCADE_BAND="low,high" the cascade leg's fp32 rescue band,
+BENCH_SERVE_CACHE=1 the admission-cache leg — duplicate-heavy seeded
+dedup schedule through a content-addressed cache
+(BENCH_SERVE_CACHE_CAPACITY/BENCH_SERVE_CACHE_UNIQUE size it), the
+record gaining hit-rate / device-calls-avoided / real-tokens-saved;
 train_step — A/B the Siamese train step's collation, pad-to-max vs
 bucketed+anchor-dedup over one identical pair stream, reporting padded-
 vs real-token throughput for both paths,
@@ -812,11 +816,37 @@ def _run_serve_micro() -> None:
             "BENCH_SERVE_TRACE_RATE", "1.0" if impl_mode == "ab" else "0.0"
         )
     )
+    # content-addressed admission-cache leg (docs/multitenancy.md):
+    # BENCH_SERVE_CACHE=1 sizes an exact-duplicate cache AND swaps the
+    # text schedule to the seeded dedup pattern (serving/loadgen.py),
+    # so the record measures what repeats are worth — hit rate, device
+    # calls avoided, real tokens never tokenized.  Off by default: the
+    # uncached record stays byte-identical.
+    cache_on = os.environ.get("BENCH_SERVE_CACHE") == "1"
+    cache_capacity = (
+        int(os.environ.get("BENCH_SERVE_CACHE_CAPACITY", "512"))
+        if cache_on else 0
+    )
+    if cache_on:
+        from memvul_tpu.serving.loadgen import LoadConfig, request_texts
+
+        texts = request_texts(
+            LoadConfig(
+                pattern="dedup",
+                requests=n_requests,
+                dedup_unique=int(
+                    os.environ.get("BENCH_SERVE_CACHE_UNIQUE", "32")
+                ),
+                seed=0,
+            ),
+            texts,
+        )
     service_config = ServiceConfig(
         max_batch=max_batch, max_wait_ms=max_wait_ms,
         max_queue=max(256, 2 * n_clients * max_batch),
         default_deadline_ms=0.0,  # measure latency, don't shed it
         trace_sample_rate=trace_rate,
+        cache_capacity=cache_capacity,
     )
     token_budget = int(
         os.environ.get("BENCH_SERVE_TOKEN_BUDGET", str(4 * seq_len))
@@ -966,6 +996,27 @@ def _run_serve_micro() -> None:
                     if ts and ts.get("count") else None
                 ),
             }
+        if cache_on:
+            # the dedup ledger: a hit IS a device call avoided (the
+            # response is rebuilt from the cached payload without a
+            # dispatch), and tokens_saved is the real-token reduction —
+            # work the tokenizer+device never saw
+            hits = int(counters.get("cache.hits", 0))
+            misses = int(counters.get("cache.misses", 0))
+            leg["cache"] = {
+                "capacity": cache_capacity,
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": (
+                    round(hits / (hits + misses), 4)
+                    if (hits + misses) else None
+                ),
+                "device_calls_avoided": hits,
+                "real_tokens_saved": int(
+                    counters.get("cache.tokens_saved", 0)
+                ),
+                "evictions": int(counters.get("cache.evictions", 0)),
+            }
         if impl == "cascade":
             # the quantization ledger: how much traffic the int8 tier
             # answered alone vs re-dispatched into the fp32 rescue band
@@ -1014,7 +1065,7 @@ def _run_serve_micro() -> None:
             k: primary[k]
             for k in (
                 "cascade_rescored", "cascade_shortcircuit",
-                "cascade_rescore_rate", "cascade_band",
+                "cascade_rescore_rate", "cascade_band", "cache",
             )
             if k in primary
         },
@@ -1028,6 +1079,7 @@ def _run_serve_micro() -> None:
             "max_wait_ms": max_wait_ms,
             "impl_mode": impl_mode,
             "token_budget": token_budget,
+            "cache_capacity": cache_capacity,
         },
         **_program_blocks(),
     }

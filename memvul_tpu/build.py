@@ -350,6 +350,7 @@ def serve_from_archive(
     use_mesh: bool = False,
     replicas: Optional[int] = None,
     tsdb_cadence: Optional[float] = None,
+    tenants: Optional[str] = None,
 ):
     """Build a ready :class:`~memvul_tpu.serving.ScoringService` — or,
     with ``replicas > 1`` (argument or the archive's
@@ -489,6 +490,8 @@ def serve_from_archive(
         trace_sample_rate=trace_sample_rate,
         trace_ring=int(serve_cfg["trace_ring"]),
         hbm_gauges=bool(tel_cfg["hbm_gauges"]),
+        cache_capacity=int(serve_cfg["cache_capacity"] or 0),
+        prefix_share=bool(serve_cfg["prefix_share"]),
     )
     n_replicas = int(
         serve_cfg["replicas"] if replicas is None else replicas
@@ -540,6 +543,23 @@ def serve_from_archive(
                 )
         return target
 
+    def _with_tenants(target):
+        # multi-tenant bank plane (serving/tenancy.py,
+        # docs/multitenancy.md): resolve "name=store_dir,..." to per-org
+        # BankStores and install each tenant's ACTIVE bank through the
+        # gated swap path.  The CLI --tenants flag overrides the
+        # archive's serving.tenants; neither set = nothing constructed,
+        # the single-tenant path stays byte-identical.  Applied LAST so
+        # the installs roll through a fully-assembled target.
+        spec = tenants if tenants is not None else serve_cfg["tenants"]
+        if spec:
+            from .serving.tenancy import configure_tenants
+
+            configure_tenants(
+                target, spec, registry=telemetry.get_registry()
+            )
+        return target
+
     def _with_flight_recorder(target):
         # the post-hoc "what happened" plane (docs/observability.md):
         # TSDB sampler + alert rules + (with out_dir) incident bundles.
@@ -587,12 +607,14 @@ def serve_from_archive(
             cascade_high=cascade_high,
         )
         predictor.encode_anchors(anchors)
-        return _with_flight_recorder(_with_slo_monitor(_with_drift_monitor(
-            ScoringService(
-                predictor,
-                config=service_config,
-                retry_policy=retry_policy,
-                manifest_dir=out_dir,
+        return _with_tenants(_with_flight_recorder(_with_slo_monitor(
+            _with_drift_monitor(
+                ScoringService(
+                    predictor,
+                    config=service_config,
+                    retry_policy=retry_policy,
+                    manifest_dir=out_dir,
+                )
             )
         )))
 
@@ -710,7 +732,7 @@ def serve_from_archive(
             retry_policy=retry_policy,
             run_dir=out_dir,
         )
-    return _with_flight_recorder(target)
+    return _with_tenants(_with_flight_recorder(target))
 
 
 def score_corpus_from_archive(

@@ -410,6 +410,20 @@ SERVING_DEFAULTS: Dict[str, Any] = {
     "incident_min_interval_s": 30.0,  # bundle rate limit (dups dropped)
     "incident_max_bundles": 8,        # newest-N bundle retention
     "incident_window_s": 120.0,       # metric-history span per bundle
+    # multi-tenant serving plane (serving/tenancy.py; docs/
+    # multitenancy.md): "name=store_dir,..." installs one versioned
+    # anchor bank per named tenant from its BankStore; None = the
+    # single default tenant only (the pre-tenancy surface, unchanged)
+    "tenants": None,
+    # content-addressed admission cache (serving/admission_cache.py):
+    # LRU entries kept per process; 0 constructs no cache at all (the
+    # cache-off path is byte-identical to pre-cache serving)
+    "cache_capacity": 0,
+    # continuous-path segment-table aliasing (data/batching.py,
+    # PackSlotAllocator): exact-duplicate requests in one pack share a
+    # written segment instead of paying tokens.  Off by default behind
+    # the ≤1e-6 parity gate (docs/multitenancy.md, "Prefix sharing")
+    "prefix_share": False,
 }
 
 

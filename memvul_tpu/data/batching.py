@@ -555,9 +555,32 @@ class PackSlotAllocator:
     :meth:`sample` returns fresh copies with :func:`collate_ragged`'s
     exact layout, so a sealed pack is safe to hand to the device while
     the pages fill with the next pack's segments.
+
+    ``share_prefixes`` turns on segment-table aliasing (the Ragged
+    Paged Attention idea applied to the one sharing case a
+    *bidirectional* encoder permits): when an admitted sequence's
+    cap-truncated tokens EXACTLY equal a segment already written into
+    the open pack, the new row writes no tokens at all — its
+    ``row_starts`` entry points at the existing segment's CLS offset,
+    so the pooling gather reads the shared embedding.  (A strict-prefix
+    share would change the shared tokens' attention — every token
+    attends bidirectionally to the suffix — so only whole-segment
+    identity keeps served scores within the ≤1e-6 parity gate; the
+    template-heavy duplicate streams this targets are exactly
+    whole-text repeats.)  Aliased rows add zero real tokens —
+    ``rows_aliased``/``tokens_aliased`` are the
+    ``serve.prefix_rows_aliased``/``serve.prefix_tokens_saved``
+    counters' source — and can be admitted even when the token budget
+    is exhausted, since they only consume a row slot.
     """
 
-    def __init__(self, token_budget: int, max_rows: int, pad_id: int) -> None:
+    def __init__(
+        self,
+        token_budget: int,
+        max_rows: int,
+        pad_id: int,
+        share_prefixes: bool = False,
+    ) -> None:
         if token_budget < 1:
             raise ValueError(f"token_budget must be >= 1, got {token_budget}")
         if max_rows < 1:
@@ -565,6 +588,12 @@ class PackSlotAllocator:
         self.token_budget = int(token_budget)
         self.max_rows = int(max_rows)
         self.pad_id = pad_id
+        self.share_prefixes = bool(share_prefixes)
+        # open-pack segment table: cap-truncated tokens -> row index
+        # (only maintained when sharing is on; cleared at reset)
+        self._segment_index: Dict[Tuple[int, ...], int] = {}
+        self.rows_aliased = 0
+        self.tokens_aliased = 0
         self._ids = np.full((1, self.token_budget), pad_id, dtype=np.int32)
         self._mask = np.zeros((1, self.token_budget), dtype=np.int32)
         self._segments = np.zeros((1, self.token_budget), dtype=np.int32)
@@ -594,18 +623,45 @@ class PackSlotAllocator:
         return self._real_tokens
 
     def fits(self, seq: Sequence[int]) -> bool:
-        """Whether :meth:`admit` would accept ``seq`` right now."""
+        """Whether :meth:`admit` would accept ``seq`` right now.  An
+        alias candidate (sharing on, identical segment already in the
+        open pack) needs only a free row slot — no token budget."""
+        if self._rows >= self.max_rows:
+            return False
         n = min(len(seq), self.token_budget)
-        return self._rows < self.max_rows and self._offset + n <= self.token_budget
+        if (
+            self.share_prefixes
+            and tuple(seq[: self.token_budget]) in self._segment_index
+        ):
+            return True
+        return self._offset + n <= self.token_budget
 
     def admit(self, seq: Sequence[int]) -> Optional[int]:
         """Write one segment into the open pack; returns its row index,
-        or ``None`` when it does not fit (seal + reset, then retry)."""
+        or ``None`` when it does not fit (seal + reset, then retry).
+        With ``share_prefixes``, an exact duplicate of an already-open
+        segment aliases it instead of writing tokens."""
         if not self.fits(seq):
             return None
         seq = seq[: self.token_budget]
         n = len(seq)
-        row, offset = self._rows, self._offset
+        row = self._rows
+        if self.share_prefixes:
+            key = tuple(seq)
+            orig = self._segment_index.get(key)
+            if orig is not None:
+                # alias: point this row's pooling gather at the
+                # original segment's CLS token; no tokens written, no
+                # real-token cost — the measured prefix-share win
+                self._row_starts[row] = self._row_starts[orig]
+                self._rows = row + 1
+                self.rows_aliased += 1
+                self.tokens_aliased += n
+                if self._generation and row < self._high_water:
+                    self.slots_reused += 1
+                return row
+            self._segment_index[key] = row
+        offset = self._offset
         self._ids[0, offset : offset + n] = seq
         self._mask[0, offset : offset + n] = 1
         self._segments[0, offset : offset + n] = row + 1
@@ -645,6 +701,7 @@ class PackSlotAllocator:
         self._rows = 0
         self._offset = 0
         self._real_tokens = 0
+        self._segment_index.clear()
         self._generation += 1
 
 

@@ -54,6 +54,14 @@ point              fires
                    lands in ``incident.dump_errors`` and must never
                    block or delay request resolution (the trigger side
                    is a non-blocking bounded-queue put)
+``cache.lookup``   once per admission-cache probe, before the LRU map is
+                   read (serving/admission_cache.py) — a firing degrades
+                   that lookup to a miss (one ``cache.errors``): a broken
+                   cache costs a device call, never a request
+``bank.resolve``   once per submitted request, at tenant→bank resolution
+                   (serving/service.py) — a firing errors that ONE
+                   request (``serve.errors``; the exact-counter
+                   invariant keeps summing) and touches no other tenant
 =================  ==========================================================
 
 With no configuration every point is a near-zero-cost no-op.  Arming is
@@ -114,6 +122,8 @@ REGISTERED_POINTS = frozenset({
     "host.stall",
     "scaler.spawn",
     "incident.dump",
+    "cache.lookup",
+    "bank.resolve",
 })
 REGISTERED_POINT_PREFIXES = (
     "step.", "replica.kill.", "shard.kill.", "shard.stall.",

@@ -12,6 +12,13 @@ on top of either.  Above the single host: ``serve --hosts`` fronts a
 :class:`HostBalancer` over per-host fleets (serving/fleet.py), and
 ``serving.autoscale_enabled`` closes the ``scale_hint`` loop with a
 live :class:`Autoscaler` (serving/autoscaler.py).
+
+Multi-tenancy (docs/multitenancy.md): ``serve --tenants`` resolves
+per-org anchor banks from versioned :class:`~memvul_tpu.bankops.store.
+BankStore` directories through one warmed encoder
+(serving/tenancy.py), and ``serving.cache_capacity`` puts a
+content-addressed exact-duplicate :class:`AdmissionCache` in front of
+admission (serving/admission_cache.py).
 """
 
 from .service import (  # noqa: F401
@@ -44,12 +51,25 @@ from .fleet import (  # noqa: F401
     enumerate_hosts,
 )
 from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
+from .admission_cache import AdmissionCache, text_digest  # noqa: F401
+from .tenancy import (  # noqa: F401
+    DEFAULT_TENANT,
+    TenantManager,
+    TenantSpecError,
+    configure_tenants,
+    demote_tenant,
+    install_tenant_bank,
+    parse_tenant_spec,
+    promote_tenant,
+    validate_tenant_name,
+)
 from .loadgen import (  # noqa: F401
     LoadConfig,
     LoadGenerator,
     arrival_offsets,
     fleet_snapshot,
     request_deadlines,
+    request_texts,
     run_slo_harness,
 )
 from .slo import (  # noqa: F401

@@ -67,6 +67,7 @@ from .service import (
     _BankVersion,
     _Request,
 )
+from .tenancy import DEFAULT_TENANT
 
 logger = logging.getLogger(__name__)
 
@@ -189,11 +190,32 @@ class Dispatcher:
                 live.append(request)
         if not live:
             return
-        with svc._bank_lock:
-            bank = svc._bank  # ONE snapshot for the whole pull
         seqs = svc.predictor.encoder.encode_many([r.text for r in live])
         svc._count_truncated(live, seqs)
-        self._dispatch_live(live, seqs, bank)
+        # group the pull by tenant: ONE bank snapshot per tenant group
+        # (the per-tenant no-torn-mix guarantee, serving/tenancy.py).
+        # The single-tenant case degenerates to exactly the old path —
+        # one group, one snapshot, one _dispatch_live call.
+        groups: Dict[str, List[Tuple[_Request, List[int]]]] = {}
+        for request, seq in zip(live, seqs):
+            request.n_tokens = len(seq)  # the cache's tokens-saved ledger
+            groups.setdefault(request.tenant, []).append((request, seq))
+        for tenant, grouped in groups.items():
+            try:
+                bank = svc._bank_for(tenant)
+            except KeyError as e:  # pragma: no cover - submit() validates
+                reason = exception_text(e)
+                svc._tel.counter("serve.errors").inc(len(grouped))
+                svc._tenant_count(tenant, "errors", len(grouped))
+                for request, _ in grouped:
+                    request.future.resolve(
+                        {"status": STATUS_ERROR, "reason": reason}
+                    )
+                    svc._finish_trace(request, STATUS_ERROR)
+                continue
+            self._dispatch_live(
+                [r for r, _ in grouped], [s for _, s in grouped], bank
+            )
 
     def _dispatch_live(
         self,
@@ -293,6 +315,7 @@ class Dispatcher:
             tel.counter("serve.errors").inc(len(chunk))
             response = {"status": STATUS_ERROR, "reason": reason}
             for request, _ in chunk:
+                svc._tenant_count(request.tenant, "errors")
                 request.future.resolve(dict(response))
                 svc._finish_trace(request, STATUS_ERROR)
             return None
@@ -360,8 +383,18 @@ class Dispatcher:
         tel.progress()
         now = time.monotonic()
         anchor_stats = svc.config.anchor_stats
+        cache = svc.admission_cache
+        weights = bank.weights
         for (request, _), row in zip(chunk, probs):
-            best = int(np.argmax(row))
+            # reweight (bankops phase 3): the *winner selection* uses the
+            # per-anchor weighted scores, the reported probabilities stay
+            # raw.  A weight-1.0 bank carries weights=None and never
+            # enters this branch — bitwise-unchanged by construction
+            # (the evaluate_reweight parity gate, bankops/promote.py)
+            if weights is not None:
+                best = int(np.argmax(row * weights))
+            else:
+                best = int(np.argmax(row))
             tel.histogram("serve.latency_s").observe(
                 now - request.enqueued_monotonic
             )
@@ -375,7 +408,7 @@ class Dispatcher:
                 tel.histogram(f"bank.anchor_score.{label}").observe(
                     float(row[best])
                 )
-            request.future.resolve({
+            response = {
                 "status": STATUS_OK,
                 "predict": {
                     label: float(p) for label, p in zip(bank.labels, row)
@@ -386,7 +419,17 @@ class Dispatcher:
                 "latency_ms": round(
                     (now - request.enqueued_monotonic) * 1e3, 3
                 ),
-            })
+            }
+            if cache is not None:
+                # before resolve: the client owns the resolved dict, the
+                # cache copies its payload fields out of this one
+                cache.store(
+                    request.tenant, request.text, bank.version,
+                    svc._score_impl, svc._precision, response,
+                    n_tokens=request.n_tokens,
+                )
+            svc._tenant_count(request.tenant, "served")
+            request.future.resolve(response)
             trace = request.trace
             if trace is not None:
                 # the four stage histograms partition enqueued→resolved
@@ -680,12 +723,16 @@ class ContinuousDispatcher(Dispatcher):
         self._token_budget = service._token_budget
         self._max_rows = service._max_rows
         self._alloc = PackSlotAllocator(
-            self._token_budget, self._max_rows, predictor.encoder.pad_id
+            self._token_budget, self._max_rows, predictor.encoder.pad_id,
+            share_prefixes=bool(service.config.prefix_share),
         )
         # admission-thread-only state (never touched by the worker)
         self._open: List[Tuple[_Request, List[int]]] = []
+        self._open_tenant: str = DEFAULT_TENANT
         self._flush_at: Optional[float] = None
         self._slots_reported = 0
+        self._aliased_rows_reported = 0
+        self._aliased_tokens_reported = 0
         # cross-thread state: plain objects with their own synchronization
         self._handoff: "queue.Queue[Optional[_SealedPack]]" = queue.Queue(
             maxsize=1
@@ -774,6 +821,7 @@ class ContinuousDispatcher(Dispatcher):
             return
         seq = svc.predictor.encoder.encode_many([request.text])[0]
         svc._count_truncated([request], [seq])
+        request.n_tokens = len(seq)  # the cache's tokens-saved ledger
         # in-flight the moment it leaves the queue: a hard kill's sweep
         # must find popped-but-unresolved requests wherever they sit —
         # open pack, handoff, or on device
@@ -784,6 +832,12 @@ class ContinuousDispatcher(Dispatcher):
             # continuous admission, enqueued→coalesced (queue_wait) is
             # the pop latency, decoupled from the device round-trip
             request.trace.coalesced = now
+        if self._open and request.tenant != self._open_tenant:
+            # a pack serves ONE tenant's bank snapshot — a tenant switch
+            # seals the open pack rather than mixing snapshots in-flight
+            self._seal_and_submit()
+            if svc._killed.is_set():
+                return
         row = self._alloc.admit(seq)
         if row is None:
             self._seal_and_submit()
@@ -799,6 +853,7 @@ class ContinuousDispatcher(Dispatcher):
             self._flush_at = (
                 time.monotonic() + svc.config.max_wait_ms / 1000.0
             )
+            self._open_tenant = request.tenant
         self._open.append((request, seq))
         if self._alloc.rows >= self._max_rows:
             self._seal_and_submit()
@@ -812,8 +867,9 @@ class ContinuousDispatcher(Dispatcher):
         if not self._open:
             return
         svc = self.service
-        with svc._bank_lock:
-            bank = svc._bank
+        # the open pack is single-tenant by construction (_admit seals on
+        # a tenant switch), so ONE per-tenant snapshot covers it
+        bank = svc._bank_for(self._open_tenant)
         chunk, self._open = self._open, []
         self._flush_at = None
         sample = self._alloc.sample()
@@ -823,6 +879,16 @@ class ContinuousDispatcher(Dispatcher):
         if reused:
             self._slots_reported = self._alloc.slots_reused
             svc._tel.counter("serve.pack_slots_reused").inc(reused)
+        aliased = self._alloc.rows_aliased - self._aliased_rows_reported
+        if aliased:
+            # prefix-share (serving.prefix_share): rows that reused an
+            # already-written identical segment instead of paying tokens
+            self._aliased_rows_reported = self._alloc.rows_aliased
+            svc._tel.counter("serve.prefix_rows_aliased").inc(aliased)
+        saved = self._alloc.tokens_aliased - self._aliased_tokens_reported
+        if saved:
+            self._aliased_tokens_reported = self._alloc.tokens_aliased
+            svc._tel.counter("serve.prefix_tokens_saved").inc(saved)
         if svc._trace_enabled:
             batch = next(svc._batch_seq)
             for request, _ in chunk:

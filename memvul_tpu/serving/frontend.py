@@ -162,6 +162,12 @@ class ScoreHandler(BaseHTTPRequestHandler):
             scaler = getattr(service, "autoscaler", None)
             if scaler is not None:
                 summary["autoscaler"] = scaler.status()
+            # the tenancy plane attaches the same way (a bare service's
+            # health_summary already embeds it; a router target gets it
+            # added here) — summary() is a dict copy, a snapshot read
+            manager = getattr(service, "tenant_manager", None)
+            if manager is not None and "tenancy" not in summary:
+                summary["tenancy"] = manager.summary()
             self._reply(503 if summary["draining"] else 200, summary)
             return
         if path == "/metrics":
@@ -274,6 +280,14 @@ class ScoreHandler(BaseHTTPRequestHandler):
             deadline_ms = payload.get("deadline_ms")
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)
+            # tenant resolution (docs/multitenancy.md): JSON field wins,
+            # then the X-MemVul-Tenant header; absent = default tenant,
+            # so every pre-tenancy client keeps working unchanged
+            tenant = payload.get("tenant") or self.headers.get(
+                "X-MemVul-Tenant"
+            )
+            if tenant is not None and not isinstance(tenant, str):
+                raise TypeError("'tenant' must be a string")
         except (KeyError, TypeError, ValueError) as e:
             self._reply(400, {
                 "status": "error",
@@ -283,7 +297,7 @@ class ScoreHandler(BaseHTTPRequestHandler):
         service = self.server.service
         # enqueue + wait on the future — the ONLY service interaction a
         # handler is allowed (lint_no_blocking_in_handler)
-        future = service.submit(text, deadline_ms=deadline_ms)
+        future = service.submit(text, deadline_ms=deadline_ms, tenant=tenant)
         wait_s = _RESULT_SLACK_S + (
             deadline_ms / 1000.0
             if deadline_ms and deadline_ms > 0

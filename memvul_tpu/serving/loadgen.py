@@ -19,7 +19,14 @@ turns one run into a parseable SLO record — the thing
 * ``slowloris`` — poisson plus a fraction of *deadline abusers*:
   requests carrying near-zero deadlines that are admitted, queue, and
   then shed — capacity held briefly and returned, the admission-
-  control pressure a public endpoint actually sees.
+  control pressure a public endpoint actually sees;
+* ``dedup`` — poisson arrivals whose texts are seeded Zipf-ish repeats
+  over a small unique pool (``dedup_unique``, skew ``dedup_alpha``),
+  optionally sharing a template prefix (``template_prefix``) — the
+  duplicate-heavy shape vulnerability-report traffic actually has
+  (boilerplate templates, resubmitted advisories), which is what the
+  admission cache (serving/admission_cache.py) and the pack prefix-
+  share path (``serving.prefix_share``) monetize.
 
 The report sums outcomes **per cause** (ok / shed / deadline / drain /
 error / hang) and asserts the one number that must always be zero:
@@ -42,7 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
-PATTERNS = ("closed", "poisson", "burst", "diurnal", "slowloris")
+PATTERNS = ("closed", "poisson", "burst", "diurnal", "slowloris", "dedup")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +68,9 @@ class LoadConfig:
     diurnal_floor: float = 0.25   # diurnal: trough rate as a peak fraction
     abuser_frac: float = 0.1      # slowloris: deadline-abuser fraction
     abuser_deadline_ms: float = 1.0  # slowloris: the abusive deadline
+    dedup_unique: int = 16        # dedup: distinct texts in the pool
+    dedup_alpha: float = 1.1      # dedup: Zipf skew (higher = more repeats)
+    template_prefix: str = ""     # dedup: shared boilerplate prepended to all
     result_timeout_s: float = 60.0  # future-collection bound (hang detector)
 
     def __post_init__(self) -> None:
@@ -101,13 +111,38 @@ def arrival_offsets(config: LoadConfig) -> List[float]:
             t += rng.expovariate(1.0) / rate
             offsets.append(t)
         return offsets
-    # poisson and slowloris share the steady-state arrival process
+    # poisson, slowloris and dedup share the steady-state arrival process
     offsets = []
     t = 0.0
     for _ in range(n):
         t += rng.expovariate(max(config.rps, 1e-6))
         offsets.append(t)
     return offsets
+
+
+def request_texts(config: LoadConfig, texts: Sequence[str]) -> List[str]:
+    """Per-request text schedule, deterministic in ``config``.  Every
+    pattern but ``dedup`` cycles round-robin (maximal text diversity —
+    the pre-dedup behaviour, byte-identical).  ``dedup`` draws Zipf-ish
+    repeats from a ``dedup_unique``-sized pool (rank-``r`` text gets
+    weight ``1/(r+1)^dedup_alpha``) and prepends ``template_prefix`` to
+    every draw, so a run has a knowable exact-duplicate rate the cache
+    hit-rate assertions can be written against."""
+    if not texts:
+        raise ValueError("load generation needs at least one text")
+    n = config.requests
+    if config.pattern != "dedup":
+        return [texts[i % len(texts)] for i in range(n)]
+    rng = random.Random(config.seed ^ 0xDED0)
+    pool = [str(t) for t in texts[: max(1, min(config.dedup_unique, len(texts)))]]
+    weights = [
+        1.0 / float(rank + 1) ** config.dedup_alpha
+        for rank in range(len(pool))
+    ]
+    prefix = config.template_prefix or ""
+    return [
+        prefix + rng.choices(pool, weights=weights)[0] for _ in range(n)
+    ]
 
 
 def request_deadlines(config: LoadConfig) -> List[Optional[float]]:
@@ -152,6 +187,7 @@ class LoadGenerator:
         if not texts:
             raise ValueError("load generation needs at least one text")
         deadlines = request_deadlines(cfg)
+        schedule = request_texts(cfg, texts)
         entries: List[Dict[str, Any]] = []
         entries_lock = threading.Lock()
 
@@ -172,7 +208,7 @@ class LoadGenerator:
                         return
                     t0 = time.perf_counter()
                     future = self.submit(
-                        texts[i % len(texts)], deadline_ms=deadlines[i]
+                        schedule[i], deadline_ms=deadlines[i]
                     )
                     # closed loop: wait before taking the next request
                     try:
@@ -198,7 +234,7 @@ class LoadGenerator:
                 t0 = time.perf_counter()
                 _record(
                     i, t0,
-                    self.submit(texts[i % len(texts)], deadline_ms=deadlines[i]),
+                    self.submit(schedule[i], deadline_ms=deadlines[i]),
                 )
         submitted_span = time.perf_counter() - start
 
@@ -361,6 +397,28 @@ def run_slo_harness(
         }
         if balancer:
             record.setdefault("hosts", {})["counters"] = balancer
+    # admission-cache view (serving/admission_cache.py): one cache per
+    # service, so a fleet sums the per-replica registries; a bare
+    # service's counters live in its own registry.  ``hits`` IS the
+    # device-calls-avoided number — a hit resolves without a dispatch.
+    cache_sources = (
+        [r.registry for r in replicas] if replicas
+        else [registry] if registry is not None else []
+    )
+    cache: Dict[str, Any] = {}
+    for source in cache_sources:
+        if not hasattr(source, "snapshot"):
+            continue
+        for name, value in source.snapshot()["counters"].items():
+            if name.startswith("cache."):
+                key = name.split(".", 1)[1]
+                cache[key] = cache.get(key, 0) + value
+    if cache:
+        hits = cache.get("hits", 0)
+        lookups = hits + cache.get("misses", 0)
+        cache["hit_rate"] = round(hits / lookups, 4) if lookups else 0.0
+        cache["device_calls_avoided"] = hits
+        record["cache"] = cache
     scaler = getattr(target, "autoscaler", None)
     if scaler is not None:
         record["autoscaler"] = scaler.status()

@@ -119,6 +119,7 @@ class Replica:
         deadline_ms: Optional[float] = None,
         trace_id: Optional[str] = None,
         hops: int = 0,
+        tenant: Optional[str] = None,
     ) -> ScoreFuture:
         """Enqueue on this replica's service.  Raises :class:`ReplicaDead`
         when the replica is dead — including the moment the
@@ -137,7 +138,8 @@ class Replica:
             self.kill(reason=f"injected: {e}")
             raise ReplicaDead(f"{self.name} killed by fault injection") from e
         return self.service.submit(
-            text, deadline_ms=deadline_ms, trace_id=trace_id, hops=hops
+            text, deadline_ms=deadline_ms, trace_id=trace_id, hops=hops,
+            tenant=tenant,
         )
 
     @property
@@ -190,6 +192,10 @@ class Replica:
         if pending:
             self.registry.counter("serve.errors").inc(len(pending))
             self.registry.counter("serve.errors_lost").inc(len(pending))
+            for request in pending:
+                # per-tenant error ledger (no-op single-tenant): the
+                # per-tenant counter sums must survive a death too
+                self.service._tenant_count(request.tenant, "errors")
             self.registry.event(
                 "replica_swept", replica=self.name, lost=len(pending)
             )
@@ -288,14 +294,16 @@ class Replica:
         version: Optional[int] = None,
         source: str = "rolling_swap",
         store_version: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> int:
         """Encode + pre-warm + install a bank on this replica's service
         at an explicit fleet version (the rolling-swap step; see
         ``ScoringService.swap_bank`` for the no-torn-snapshot story and
-        the provenance fields)."""
+        the provenance fields).  ``tenant`` targets a named tenant's
+        bank slot (serving/tenancy.py)."""
         return self.service.swap_bank(
             anchor_instances, version=version,
-            source=source, store_version=store_version,
+            source=source, store_version=store_version, tenant=tenant,
         )
 
     # -- shutdown --------------------------------------------------------------

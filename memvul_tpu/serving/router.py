@@ -63,6 +63,7 @@ from .service import (
     STATUS_OK,
     ScoreFuture,
 )
+from .tenancy import DEFAULT_TENANT
 
 logger = logging.getLogger(__name__)
 
@@ -91,6 +92,7 @@ class _RoutedRequest:
     deadline_monotonic: Optional[float]
     future: ScoreFuture
     pinned_version: int
+    tenant: Optional[str] = None
     attempts: int = 0
 
 
@@ -136,6 +138,11 @@ class ReplicaRouter:
         self._bank_instances: Optional[List[Dict]] = None
         self._bank_source: str = "rolling_swap"
         self._bank_store_version: Optional[str] = None
+        # per-tenant fleet bank content + provenance + fleet version,
+        # for re-install on restart/spawn (serving/tenancy.py): a fresh
+        # replica carries only the factory default bank, so every named
+        # tenant's bank must be re-rolled onto it before readmission
+        self._tenant_banks: Dict[str, tuple] = {}
         self._shadow_tap = None  # re-attached onto autoscaler-spawned members
         self._default_deadline_ms = self.replicas[0].service.default_deadline_ms
         self._recovering: Dict[str, bool] = {}
@@ -266,7 +273,10 @@ class ReplicaRouter:
     # -- dispatch --------------------------------------------------------------
 
     def submit(
-        self, text: str, deadline_ms: Optional[float] = None
+        self,
+        text: str,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> ScoreFuture:
         """Route one request: pin it to the fleet's active bank version,
         pick the least-loaded healthy replica, relay its response.  The
@@ -292,6 +302,7 @@ class ReplicaRouter:
             ),
             future=future,
             pinned_version=self._active_version,
+            tenant=tenant,
         )
         self._route(request)
         return future
@@ -336,6 +347,7 @@ class ReplicaRouter:
             inner = replica.submit(
                 request.text, deadline_ms=self._remaining_ms(request),
                 trace_id=f"r-{request.rid}", hops=request.attempts,
+                tenant=request.tenant,
             )
         except ReplicaDead:
             with self._lock:
@@ -614,6 +626,19 @@ def _sync_bank(router: ReplicaRouter, replica: Replica) -> None:
                 store_version=router._bank_store_version,
             )
             replica.accepting.set()
+        # named tenant banks never survive a rebuild (the factory builds
+        # only the default bank), so re-roll every one of them — a death
+        # mid-tenant-rollout cannot leave this member serving no (or an
+        # old) bank for a tenant the fleet serves (serving/tenancy.py)
+        for tenant, (instances, source, store_version, version) in (
+            router._tenant_banks.items()
+        ):
+            replica.accepting.clear()
+            replica.install_bank(
+                instances, version=version,
+                source=source, store_version=store_version, tenant=tenant,
+            )
+            replica.accepting.set()
 
 
 def rolling_swap(
@@ -623,6 +648,7 @@ def rolling_swap(
     poll_interval_s: float = 0.01,
     source: str = "rolling_swap",
     store_version: Optional[str] = None,
+    tenant: Optional[str] = None,
 ) -> int:
     """Roll a new anchor bank across the fleet, one replica at a time.
 
@@ -642,13 +668,28 @@ def rolling_swap(
     OUTSIDE the router class — routing decisions may not encode, warm,
     or sleep (tools/lint_no_blocking_in_handler.py).  Returns the new
     fleet version.
+
+    ``tenant`` scopes the roll to one named tenant's bank
+    (serving/tenancy.py): the same per-replica stop-drain-install-readmit
+    discipline, but the fleet's *default* active version — which new
+    admissions pin to — is untouched, so a tenant rollout can never tear
+    any other tenant's responses.  The tenant's fleet version advances
+    independently, recorded so restarts and autoscaler spawns re-install
+    the tenant bank before readmission (``_sync_bank``).
     """
     instances = list(anchor_instances)
     tel = router._tel
+    named = tenant is not None and tenant != DEFAULT_TENANT
     with router._swap_lock:
-        target = router._active_version + 1
+        if named:
+            prior = router._tenant_banks.get(tenant)
+            target = prior[3] + 1 if prior is not None else 1
+        else:
+            target = router._active_version + 1
         tel.event(
-            "rolling_swap_start", version=target, replicas=len(router.replicas)
+            "rolling_swap_start", version=target,
+            replicas=len(router.replicas),
+            tenant=tenant if named else DEFAULT_TENANT,
         )
         with tel.span("router.rolling_swap", version=target):
             for replica in router._members():
@@ -671,6 +712,7 @@ def rolling_swap(
                 replica.install_bank(
                     instances, version=target,
                     source=source, store_version=store_version,
+                    tenant=tenant if named else None,
                 )
                 with replica._state_lock:
                     replica.state = previous_state
@@ -678,15 +720,27 @@ def rolling_swap(
                 tel.event(
                     "replica_swap_done", replica=replica.name, version=target
                 )
-        router._bank_instances = instances
-        router._bank_source = source
-        router._bank_store_version = store_version
-        router._active_version = target
+        if named:
+            router._tenant_banks[tenant] = (
+                instances, source, store_version, target
+            )
+        else:
+            router._bank_instances = instances
+            router._bank_source = source
+            router._bank_store_version = store_version
+            router._active_version = target
     tel.counter("router.bank_swaps").inc()
-    tel.gauge("router.bank_version").set(target)
-    tel.event("rolling_swap_done", version=target)
+    if named:
+        tel.gauge(f"bank.{tenant}.version").set(target)
+    else:
+        tel.gauge("router.bank_version").set(target)
+    tel.event(
+        "rolling_swap_done", version=target,
+        tenant=tenant if named else DEFAULT_TENANT,
+    )
     logger.info(
-        "rolling swap complete: fleet at bank v%d (%d replicas)",
-        target, len(router.replicas),
+        "rolling swap complete: %s at bank v%d (%d replicas)",
+        f"tenant {tenant}" if named else "fleet", target,
+        len(router.replicas),
     )
     return target

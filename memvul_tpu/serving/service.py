@@ -77,8 +77,12 @@ import time
 from pathlib import Path
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
+import numpy as np
+
+from ..resilience import faults
 from ..resilience.retry import RetryPolicy
 from ..telemetry import get_registry
+from .tenancy import DEFAULT_TENANT
 
 logger = logging.getLogger(__name__)
 
@@ -115,6 +119,16 @@ class ServiceConfig:
     # serve.hbm_peak_bytes at heartbeat cadence (no-op on backends
     # without memory stats, e.g. CPU)
     hbm_gauges: bool = True
+    # content-addressed admission cache (serving/admission_cache.py):
+    # > 0 bounds an exact-duplicate LRU that answers repeats without a
+    # device call; 0 (default) constructs nothing — the cache-off
+    # request path is byte-identical to pre-cache builds
+    cache_capacity: int = 0
+    # continuous-pack duplicate aliasing (docs/multitenancy.md): an
+    # admitted request whose cap-truncated token sequence exactly
+    # matches an open-pack row shares that row's segment instead of
+    # paying new token slots; off by default (serving.prefix_share)
+    prefix_share: bool = False
 
 
 class ScoreFuture:
@@ -241,6 +255,12 @@ class _Request:
     enqueued_monotonic: float
     deadline_monotonic: Optional[float]  # None = no deadline
     trace: Optional[_Trace] = None       # present only when tracing is on
+    # which org's anchor bank scores this request (serving/tenancy.py);
+    # untagged requests ride the default tenant — full back-compat
+    tenant: str = DEFAULT_TENANT
+    # real token count, stamped at encode time by the dispatcher — the
+    # admission cache's tokens-saved ledger reads it back on a hit
+    n_tokens: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -263,6 +283,38 @@ class _BankVersion:
     source: str = "startup"
     parent_version: Optional[int] = None
     store_version: Optional[str] = None
+    # which tenant's bank this snapshot is (serving/tenancy.py)
+    tenant: str = DEFAULT_TENANT
+    # per-anchor weights for the weighted max-over-anchors reweight
+    # path (bankops stores them per category).  ``None`` — the all-1.0
+    # case — skips the weighting arithmetic entirely, so an unweighted
+    # bank's scores are bitwise-unchanged by construction (the
+    # evaluate_reweight parity gate's guarantee)
+    weights: Any = None
+
+
+def _bank_weights(instances: List[Dict], n_anchors: int):
+    """Per-anchor weight vector pulled from the instances' meta, aligned
+    with encode order (``encode_bank`` preserves instance order).
+    Returns ``None`` for the trivial all-1.0 bank so the scoring path
+    skips the multiply and stays bitwise-identical to pre-reweight
+    behavior."""
+    if len(instances) != int(n_anchors):
+        # an encoder that reorders or resamples its anchors can't be
+        # aligned with the per-instance weights — serve unweighted
+        # rather than misattribute weights across categories
+        logger.warning(
+            "bank weights dropped: %d instances vs %d anchors",
+            len(instances), n_anchors,
+        )
+        return None
+    raw = [
+        float((inst.get("meta") or {}).get("weight", 1.0))
+        for inst in instances
+    ]
+    if all(w == 1.0 for w in raw):
+        return None
+    return np.asarray(raw, dtype=np.float32)
 
 
 class ScoringService:
@@ -319,6 +371,25 @@ class ScoringService:
         )
         self._bank_lock = threading.Lock()
         self._swap_lock = threading.Lock()  # one swap at a time
+        # per-tenant bank snapshots (serving/tenancy.py): named tenants
+        # only — the default tenant stays ``self._bank`` so every
+        # single-tenant code path is untouched.  Guarded by _bank_lock.
+        self._banks: Dict[str, _BankVersion] = {}
+        self._multi_tenant = False  # flips on the first named install
+        # bank geometries the predictor has warmed programs for — a
+        # swap only pays the AOT re-warm for a genuinely new shape
+        self._warmed_bank_shapes = {tuple(predictor.anchor_bank.shape)}
+        # content-addressed admission cache (admission_cache.py): an
+        # exact repeat resolves on the submit thread, no device call
+        self._precision = getattr(predictor, "encoder_precision", "fp32")
+        self.admission_cache = None
+        if int(self.config.cache_capacity) > 0:
+            from .admission_cache import AdmissionCache
+
+            # same registry fallback the service itself uses below
+            self.admission_cache = AdmissionCache(
+                int(self.config.cache_capacity), registry=registry
+            )
         self._queue: "collections.deque[_Request]" = collections.deque()
         self._cond = threading.Condition()
         # drain is signalled via a bare Event (no lock acquisition) so
@@ -380,6 +451,7 @@ class ScoringService:
         deadline_ms: Optional[float] = None,
         trace_id: Optional[str] = None,
         hops: int = 0,
+        tenant: Optional[str] = None,
     ) -> ScoreFuture:
         """Enqueue one report text; returns immediately with a future.
 
@@ -387,6 +459,17 @@ class ScoringService:
         refused with ``"drain"``; on queue overflow the *oldest* queued
         request is shed with ``"shed"`` to make room (FIFO eviction —
         the newest request has the freshest deadline).
+
+        ``tenant`` routes the request to that org's anchor bank
+        (serving/tenancy.py); ``None``/empty means the default tenant —
+        every pre-tenancy caller is unchanged.  A tenant with no
+        installed bank resolves ``"error"`` (counted in
+        ``serve.errors``) without touching the queue.
+
+        With an admission cache installed, an exact repeat of an
+        already-served text resolves right here on the submit thread —
+        bitwise-identical score fields, no device call, counted as
+        served (the exact-counter invariant keeps summing).
 
         ``trace_id``/``hops`` let the router carry one journey across
         re-routes: a rerouted request keeps its id and its hop count
@@ -405,15 +488,50 @@ class ScoringService:
                 hops=int(hops),
                 received=now,
             )
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
         request = _Request(
             text=text, future=future,
             enqueued_monotonic=now, deadline_monotonic=deadline,
-            trace=trace,
+            trace=trace, tenant=tenant,
         )
         self._tel.counter("serve.requests").inc()
+        self._tenant_count(tenant, "requests")
+        # tenant resolution (chaos hook: the bank.resolve fault point).
+        # A failed resolution errors THIS request only — the counter
+        # invariant still sums and no other tenant is touched.
+        bank: Optional[_BankVersion] = None
+        try:
+            faults.fault_point("bank.resolve")
+            bank = self._bank_for(tenant)
+        except Exception as e:
+            self._tel.counter("serve.errors").inc()
+            self._tenant_count(tenant, "errors")
+            request.future.resolve({
+                "status": STATUS_ERROR,
+                "reason": f"tenant resolution failed: {e}",
+                "tenant": tenant,
+            })
+            self._finish_trace(request, STATUS_ERROR)
+            return future
         if self._draining.is_set():
             self._finish_unserved(request, STATUS_DRAIN)
             return future
+        if self.admission_cache is not None:
+            payload = self.admission_cache.lookup(
+                tenant, text, bank.version, self._score_impl,
+                self._precision,
+            )
+            if payload is not None:
+                self._tel.counter("serve.served").inc()
+                self._tenant_count(tenant, "served")
+                payload["status"] = STATUS_OK
+                payload["latency_ms"] = round(
+                    (time.monotonic() - now) * 1000.0, 3
+                )
+                payload["cached"] = True
+                request.future.resolve(payload)
+                self._finish_trace(request, STATUS_OK)
+                return future
         shed: Optional[_Request] = None
         with self._cond:
             if len(self._queue) >= self.config.max_queue:
@@ -448,6 +566,35 @@ class ScoringService:
         health/manifest paths report."""
         with self._bank_lock:
             return self._bank
+
+    def _bank_for(self, tenant: str) -> _BankVersion:
+        """One tenant's current bank snapshot.  Default tenant =
+        ``self._bank`` (the pre-tenancy path, bitwise-unchanged);
+        a named tenant with no installed bank raises."""
+        with self._bank_lock:
+            if tenant == DEFAULT_TENANT:
+                return self._bank
+            bank = self._banks.get(tenant)
+        if bank is None:
+            raise KeyError(f"unknown tenant {tenant!r}")
+        return bank
+
+    def tenant_banks(self) -> Dict[str, _BankVersion]:
+        """Snapshot of every installed tenant bank (default included) —
+        the health/tenancy introspection view."""
+        with self._bank_lock:
+            out = {DEFAULT_TENANT: self._bank}
+            out.update(self._banks)
+        return out
+
+    def _tenant_count(self, tenant: str, what: str, n: int = 1) -> None:
+        """Per-tenant ``serve.<tenant>.*`` labels.  Emitted only once a
+        named tenant bank is installed (multi-tenant mode), so the
+        single-tenant metric surface stays byte-identical; in
+        multi-tenant mode EVERY request is labeled (default included),
+        making the per-tenant ledgers sum to the fleet invariant."""
+        if self._multi_tenant and n:
+            self._tel.counter(f"serve.{tenant}.{what}").inc(n)
 
     # -- shadow tap (bankops/shadow.py) ---------------------------------------
 
@@ -489,7 +636,7 @@ class ScoringService:
         adds the per-replica fleet view (docs/serving.md)."""
         draining = self._draining.is_set()
         bank = self.bank_snapshot()
-        return {
+        out = {
             "status": "draining" if draining else "ok",
             "draining": draining,
             "queue_depth": self.queue_depth,
@@ -507,6 +654,25 @@ class ScoringService:
                 "store_version": bank.store_version,
             },
         }
+        if self._multi_tenant:
+            # per-tenant bank rows, additive only — the single-tenant
+            # /healthz body stays byte-identical (docs/multitenancy.md)
+            with self._bank_lock:
+                named = dict(self._banks)
+            out["tenants"] = {
+                name: {
+                    "version": b.version,
+                    "n_anchors": b.n_anchors,
+                    "source": b.source,
+                    "store_version": b.store_version,
+                    "weighted": b.weights is not None,
+                }
+                for name, b in sorted(named.items())
+            }
+        manager = getattr(self, "tenant_manager", None)
+        if manager is not None:
+            out["tenancy"] = manager.summary()
+        return out
 
     # -- live exposition (GET /metrics, /tracez) --------------------------------
 
@@ -626,6 +792,7 @@ class ScoringService:
         version: Optional[int] = None,
         source: str = "manual",
         store_version: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> int:
         """Re-encode a new anchor set and atomically install it.
 
@@ -647,51 +814,87 @@ class ScoringService:
         the snapshot, the manifest, and the ``health_summary()`` bank
         row: "manual" for an operator swap, "rolling_swap" for a fleet
         rollout, "promotion"/"demotion" for the bankops gate
-        (docs/anchor_bank.md)."""
+        (docs/anchor_bank.md).
+
+        ``tenant`` installs into a *named* tenant's bank slot instead of
+        the default bank (serving/tenancy.py): the encoder and its
+        warmed programs are shared, the snapshot is not.  Named swaps
+        emit ``bank.<tenant>.swaps``/``bank.<tenant>.version`` and do
+        not touch the default tenant's manifest."""
+        tenant = str(tenant) if tenant else DEFAULT_TENANT
+        instances = list(anchor_instances)
         with self._swap_lock:
             # the swap lock is control-plane-only (serializes concurrent
             # swaps); the request path never takes it, so encoding and
             # warming under it is deliberate, not a batcher stall
             bank, labels, n_anchors = self.predictor.encode_bank(  # lint: disable=MV301
-                anchor_instances
+                instances
             )
-            with self._bank_lock:
-                current = self._bank
-            if bank.shape != current.array.shape:
+            weights = _bank_weights(instances, n_anchors)
+            shape = tuple(bank.shape)
+            if shape not in self._warmed_bank_shapes:
                 # new bank geometry = new XLA program per stream shape;
                 # compile them here, off the request path, so the swap
-                # still never costs a mid-serve compile
+                # still never costs a mid-serve compile.  The warmed-set
+                # is keyed on geometry, not tenant: N tenants sharing a
+                # padded bank shape share the programs, so only the
+                # first bank of a given geometry pays the warm.
                 logger.info(
-                    "bank swap changes shape %s -> %s: re-warming %d "
+                    "bank swap introduces shape %s: re-warming %d "
                     "stream shape(s) before install",
-                    tuple(current.array.shape), tuple(bank.shape),
-                    len(self._rows_by_length),
+                    shape, len(self._rows_by_length),
                 )
                 with self._tel.span("serve.bank_warmup"):
                     # same contract as the encode above: control-plane
                     # lock, never contended by the request path
                     self.predictor.warmup_bank_shapes(bank)  # lint: disable=MV301
+                self._warmed_bank_shapes.add(shape)
             with self._bank_lock:
+                current = (
+                    self._bank if tenant == DEFAULT_TENANT
+                    else self._banks.get(tenant)
+                )
                 new = _BankVersion(
-                    version=current.version + 1 if version is None
-                    else int(version),
+                    version=(
+                        (current.version + 1 if current is not None else 1)
+                        if version is None else int(version)
+                    ),
                     array=bank,
                     labels=tuple(labels),
                     n_anchors=n_anchors,
                     source=source,
-                    parent_version=current.version,
+                    parent_version=(
+                        current.version if current is not None else None
+                    ),
                     store_version=store_version,
+                    tenant=tenant,
+                    weights=weights,
                 )
-                self._bank = new
+                if tenant == DEFAULT_TENANT:
+                    self._bank = new
+                else:
+                    self._banks[tenant] = new
+                    self._multi_tenant = True
         self._tel.counter("serve.bank_swaps").inc()
-        self._tel.gauge("serve.bank_version").set(new.version)
+        if tenant == DEFAULT_TENANT:
+            self._tel.gauge("serve.bank_version").set(new.version)
+        else:
+            self._tel.counter(f"bank.{tenant}.swaps").inc()
+            self._tel.gauge(f"bank.{tenant}.version").set(new.version)
         self._tel.event(
             "bank_swap", version=new.version, n_anchors=new.n_anchors,
-            source=source, store_version=store_version,
+            source=source, store_version=store_version, tenant=tenant,
         )
-        self._write_manifest()
+        if self.admission_cache is not None:
+            # eager reclamation; the version-in-key already fences
+            # correctness (serving/admission_cache.py)
+            self.admission_cache.invalidate(tenant)
+        if tenant == DEFAULT_TENANT:
+            self._write_manifest()
         logger.info(
-            "anchor bank v%d installed: %d anchors", new.version, new.n_anchors
+            "anchor bank v%d installed for tenant %s: %d anchors%s",
+            new.version, tenant, new.n_anchors,
+            "" if weights is None else " (weighted)",
         )
         return new.version
 
@@ -775,6 +978,7 @@ class ScoringService:
         tel = self._tel
         tel.counter("serve.shed").inc()
         tel.counter(sub).inc()
+        self._tenant_count(request.tenant, "shed")
         request.future.resolve({"status": status})
         self._finish_trace(request, status)
 

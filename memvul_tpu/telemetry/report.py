@@ -542,6 +542,12 @@ def _derived_metrics(counters: Dict[str, Any]) -> Dict[str, float]:
         # int8 score landed inside the uncertainty band and paid the
         # fp32 rescore (docs/quantized_serving.md)
         out["serve.cascade_rescore_rate"] = rescored / (rescored + shortcut)
+    cache_hits = _as_num(counters.get("cache.hits"))
+    cache_misses = _as_num(counters.get("cache.misses"))
+    if cache_hits + cache_misses > 0:
+        # admission cache only (serving/admission_cache.py): the share
+        # of probed requests answered without a device call
+        out["cache.hit_rate"] = cache_hits / (cache_hits + cache_misses)
     return out
 
 
@@ -973,6 +979,18 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
                 f" {rescored / (rescored + shortcut):.3f}"
                 f" ({int(rescored)}/{int(rescored + shortcut)} rescored fp32)"
             )
+        # derived: admission-cache yield — probed requests answered from
+        # the content-addressed cache without a device call
+        # (serving/admission_cache.py, docs/multitenancy.md)
+        cache_hits = _as_num(counters.get("cache.hits"))
+        cache_misses = _as_num(counters.get("cache.misses"))
+        if cache_hits + cache_misses > 0:
+            lines.append(
+                f"  cache.hit_rate ="
+                f" {cache_hits / (cache_hits + cache_misses):.3f}"
+                f" ({int(cache_hits)}/{int(cache_hits + cache_misses)}"
+                " probes hit)"
+            )
     gauges = summary.get("gauges") or {}
     if gauges:
         lines.append("")
@@ -1011,6 +1029,25 @@ def render_report(run_dir: Union[str, Path], now: Optional[float] = None) -> str
                 f"  device_time={_fmt_s(t['device_time_s'])}"
                 f"  share={t['device_time_share']:.1%}"
             )
+
+    # -- admission cache (serving/admission_cache.py) --------------------------
+    cache_hits = _as_num(counters.get("cache.hits"))
+    cache_misses = _as_num(counters.get("cache.misses"))
+    if cache_hits + cache_misses > 0:
+        lines.append("")
+        lines.append("CACHE (content-addressed admission cache)")
+        lines.append(
+            f"  hits: {int(cache_hits)}  misses: {int(cache_misses)}"
+            f"  hit_rate: {cache_hits / (cache_hits + cache_misses):.3f}"
+        )
+        lines.append(
+            f"  evictions: {int(_as_num(counters.get('cache.evictions')))}"
+            f"  invalidations:"
+            f" {int(_as_num(counters.get('cache.invalidations')))}"
+            f"  errors: {int(_as_num(counters.get('cache.errors')))}"
+            f"  tokens_saved:"
+            f" {int(_as_num(counters.get('cache.tokens_saved')))}"
+        )
 
     # -- cross-host fleet (serving/fleet.py) -----------------------------------
     fleet = _fleet_block(counters, gauges)

@@ -724,7 +724,8 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         for flag in action.option_strings
     }
     assert {
-        "--replicas", "--out-dir", "--overrides", "--port", "--tsdb-cadence"
+        "--replicas", "--out-dir", "--overrides", "--port", "--tsdb-cadence",
+        "--tenants",
     } <= serve_flags
     # the lint subcommand's flag surface is pinned too: the engine's
     # select/json/baseline workflow (docs/static_analysis.md) must stay
@@ -781,6 +782,15 @@ def test_cli_bank_help_names_every_lifecycle_subcommand(capsys):
     helps = {ca.dest: ca.help for ca in bank_sub._choices_actions}
     for name in expected:
         assert helps.get(name), f"bank subcommand {name!r} has no help text"
+    # every lifecycle step takes --tenant: one <store>/<tenant> root per
+    # org, the layout serve --tenants points at (docs/multitenancy.md)
+    for name in expected:
+        flags = {
+            flag
+            for action in bank_sub.choices[name]._actions
+            for flag in action.option_strings
+        }
+        assert "--tenant" in flags, f"bank {name} lost --tenant"
     with pytest.raises(SystemExit) as exc:
         main(["bank", "--help"])
     assert exc.value.code == 0

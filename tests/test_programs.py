@@ -505,6 +505,9 @@ def test_router_programs_snapshot_merges_and_stamps_replicas():
             _StubReplica("replica-2", None),  # dead replica: skipped
         ]
 
+        def _members(self):
+            return list(self.replicas)
+
     rows = ReplicaRouter.programs_snapshot(_StubRouter())
     assert [(r["key"], r["replica"]) for r in rows] == [
         ("score:4x8", "replica-1"),   # newest compile first, fleet-wide

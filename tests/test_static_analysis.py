@@ -907,3 +907,51 @@ def test_recorder_trigger_path_known_bad(tmp_path):
         ("pkg/bad_recorder.py", 5, "score_texts"),
         ("pkg/bad_recorder.py", 8, "pack_token_budget"),
     ], hits
+
+
+def test_cache_and_tenant_classes_selection_only_known_bad(tmp_path):
+    """The admission-cache / tenancy discipline (serving/admission_cache.py,
+    serving/tenancy.py): a ``*Cache`` that sleeps or encodes inside a
+    probe, or a ``*Tenant*`` manager that warms or installs banks
+    itself, fails MV102 — by class name and by base-class name — while
+    the legal surface (dict probes under a lock, live-version
+    bookkeeping) stays clean."""
+    _write_tree(tmp_path, {
+        "pkg/bad_cache.py": (
+            "import time\n"
+            "class AdmissionCache:\n"
+            "    def lookup(self, key):\n"
+            "        time.sleep(0.1)\n"
+            "        return self.predictor.encode_bank([key])\n"
+            "class WarmCache(AdmissionCache):\n"
+            "    def store(self, key, value):\n"
+            "        self.service.swap_bank([value])\n"
+        ),
+        "pkg/bad_tenant.py": (
+            "class TenantManager:\n"
+            "    def resolve(self, name):\n"
+            "        bank = self.predictor.encode_anchors(self._banks[name])\n"
+            "        return self.fleet.rolling_swap(bank)\n"
+        ),
+        "pkg/good_cache.py": (
+            "class AdmissionCache:\n"
+            "    def lookup(self, key):\n"
+            "        with self._lock:\n"
+            "            return self._entries.get(key)\n"
+            "class TenantManager:\n"
+            "    def live_version(self, tenant):\n"
+            "        with self._lock:\n"
+            "            return self._live.get(tenant)\n"
+        ),
+    })
+    result = _analyze_fixture(tmp_path, select=["MV102"])
+    hits = sorted(
+        (f.path, f.line, f.symbol) for f in result.active
+    )
+    assert hits == [
+        ("pkg/bad_cache.py", 4, "sleep"),
+        ("pkg/bad_cache.py", 5, "encode_bank"),
+        ("pkg/bad_cache.py", 8, "swap_bank"),
+        ("pkg/bad_tenant.py", 3, "encode_anchors"),
+        ("pkg/bad_tenant.py", 4, "rolling_swap"),
+    ], hits

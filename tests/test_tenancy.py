@@ -1,0 +1,958 @@
+"""Multi-tenant serving plane + content-addressed admission cache
+(serving/tenancy.py, serving/admission_cache.py, docs/multitenancy.md).
+
+The acceptance contract this file pins:
+
+* **tenant spec** — ``name=store_dir`` parsing with the strict
+  telemetry-label charset, duplicate and reserved-``default``
+  rejection;
+* **isolation** — two tenants on ONE service score against their OWN
+  bank snapshots (scores are a function of the bank content, so a
+  bleed is observable), and the per-tenant ``serve.<tenant>.*``
+  ledgers sum exactly to the global counter invariant;
+* **swap isolation** — a rolling swap of tenant A's bank under
+  concurrent tenant-B load never changes a single B response, and the
+  fleet's *default* active version is untouched;
+* **chaos** — a replica hard-killed while a tenant rollout is in
+  flight is restarted with BOTH tenants' banks re-installed
+  (``_sync_bank`` re-rolls named banks), end state consistent across
+  the fleet, no cross-tenant bleed at any point;
+* **admission cache** — an exact repeat is served bitwise-identical
+  WITHOUT a device call; LRU eviction is bounded, a tenant's swap
+  invalidates only that tenant's entries, and the ``cache.lookup``
+  fault degrades to a miss (a broken cache costs a device call, never
+  a request);
+* **reweight** — ``evaluate_reweight`` approves an all-1.0 bank with
+  zero flips (the parity anchor: weighted selection IS plain argmax),
+  refuses a skewed bank on flip rate, and refuses to misalign weights
+  across anchor rows;
+* **prefix share** — duplicate texts alias row slots in the continuous
+  open pack (zero real tokens, pooling gather reads the shared CLS)
+  with scores matching the unshared path ≤1e-6, off by default.
+"""
+
+import dataclasses
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from memvul_tpu import telemetry
+from memvul_tpu.bankops import (
+    BankDiff,
+    BankStore,
+    BankStoreError,
+    GateThresholds,
+    PromotionRefused,
+    evaluate_gate,
+    evaluate_reweight,
+)
+from memvul_tpu.bankops.promote import REASON_FLIP_RATE
+from memvul_tpu.data.batching import PackSlotAllocator
+from memvul_tpu.resilience import faults
+from memvul_tpu.serving import (
+    STATUS_ERROR,
+    STATUS_OK,
+    LoadConfig,
+    Replica,
+    ReplicaRouter,
+    RouterConfig,
+    ScoringService,
+    ServiceConfig,
+    TenantSpecError,
+    configure_tenants,
+    demote_tenant,
+    parse_tenant_spec,
+    promote_tenant,
+    request_texts,
+    rolling_swap,
+    run_slo_harness,
+    validate_tenant_name,
+)
+from memvul_tpu.serving.loadgen import fleet_snapshot
+
+
+@pytest.fixture()
+def tel(tmp_path):
+    registry = telemetry.configure(run_dir=tmp_path / "run")
+    yield registry
+    telemetry.reset()
+    faults.reset()
+
+
+# -- fakes: scores are a function of the BANK CONTENT, so serving a
+# -- wrong tenant's bank produces a wrong, observable score ------------------
+
+class _CharEncoder:
+    """Tokens derived from the text's characters: identical texts get
+    identical token sequences (the cache/prefix-share premise) and
+    distinct texts get distinct ones."""
+
+    pad_id = 0
+
+    def __init__(self, max_length=8):
+        self.max_length = max_length
+
+    def encode_many(self, texts):
+        return [
+            [(ord(c) % 53) + 2 for c in t[: self.max_length]] or [2]
+            for t in texts
+        ]
+
+
+def _bank_base(labels):
+    """A deterministic per-bank score offset derived from the anchor
+    labels — each distinct bank scores visibly differently."""
+    digest = hashlib.sha256("|".join(labels).encode("utf-8")).hexdigest()
+    return 0.1 + (int(digest[:4], 16) % 600) / 1000.0
+
+
+class _TenantPredictor:
+    """Minimal predictor surface whose scores embed the served bank's
+    identity: ``encode_bank`` writes a label-derived constant into the
+    bank array and ``_score_fn`` reads it back, so every response
+    proves which tenant's snapshot scored it."""
+
+    def __init__(self, n_anchors=3, rows=4, length=8):
+        self.encoder = _CharEncoder(length)
+        self.mesh = None
+        self.params = None
+        self.n_anchors = n_anchors
+        self.anchor_labels = [f"A{i}" for i in range(n_anchors)]
+        self.anchor_bank = np.zeros((n_anchors, 2), np.float32)
+        self.score_trace_count = 0
+        self._shapes = [(rows, length)]
+        self.device_calls = 0
+
+    def stream_shapes(self):
+        return list(self._shapes)
+
+    def encode_bank(self, instances):
+        instances = list(instances)
+        labels = [inst["meta"]["label"] for inst in instances]
+        bank = np.full((len(labels), 2), _bank_base(labels), np.float32)
+        return bank, labels, len(labels)
+
+    def warmup_bank_shapes(self, bank):
+        pass
+
+    def _score_fn(self, params, sample, bank):
+        self.device_calls += 1
+        rows = sample["input_ids"].shape[0]
+        base = float(bank[0, 0])
+        return np.tile(
+            base + np.linspace(0.0, 0.05, bank.shape[0], dtype=np.float32),
+            (rows, 1),
+        )
+
+
+ORG_A_BANK = [
+    {"text1": f"alpha anchor {i}", "meta": {"label": f"ALPHA-{i}"}}
+    for i in range(3)
+]
+ORG_B_BANK = [
+    {"text1": f"beta anchor {i}", "meta": {"label": f"BETA-{i}"}}
+    for i in range(3)
+]
+BASE_A = _bank_base([inst["meta"]["label"] for inst in ORG_A_BANK])
+BASE_B = _bank_base([inst["meta"]["label"] for inst in ORG_B_BANK])
+# every bank's reported "score" is its base + the linspace max
+TOP = 0.05
+
+
+def _make_service(**overrides):
+    defaults = dict(max_batch=4, max_wait_ms=1.0, max_queue=1000)
+    defaults.update(overrides)
+    predictor = _TenantPredictor()
+    return predictor, ScoringService(
+        predictor, config=ServiceConfig(**defaults)
+    )
+
+
+def _tenant_fleet(n=2, **router_kw):
+    overrides = dict(
+        max_batch=4, max_wait_ms=1.0, max_queue=1000,
+        default_deadline_ms=30000.0,
+    )
+
+    def make_factory(i):
+        def factory(registry):
+            return ScoringService(
+                _TenantPredictor(),
+                config=ServiceConfig(**overrides),
+                registry=registry,
+            )
+        return factory
+
+    replicas = [
+        Replica(i, make_factory(i), telemetry_enabled=True) for i in range(n)
+    ]
+    router = ReplicaRouter(
+        replicas,
+        config=RouterConfig(monitor_interval_s=0.05, **router_kw),
+    )
+    return router, replicas
+
+
+def _assert_tenant_ledger_sums(counters, tenants=("default", "orga", "orgb")):
+    """Multi-tenant mode labels EVERY request, so the per-tenant
+    ledgers partition the global counters exactly."""
+    for what in ("requests", "served", "errors"):
+        per_tenant = sum(
+            counters.get(f"serve.{t}.{what}", 0) for t in tenants
+        )
+        assert per_tenant == counters.get(f"serve.{what}", 0), (
+            what, counters,
+        )
+
+
+# -- tenant spec --------------------------------------------------------------
+
+def test_parse_tenant_spec_and_name_validation():
+    spec = parse_tenant_spec("orga=/banks/a, orgb=/banks/b,")
+    assert spec == {"orga": "/banks/a", "orgb": "/banks/b"}
+    assert validate_tenant_name("org-1_x") == "org-1_x"
+    for bad in ("Org", "a b", "-lead", "", "x" * 65):
+        with pytest.raises(TenantSpecError):
+            validate_tenant_name(bad)
+    for bad_spec in (
+        "orga",                      # no =
+        "orga=",                     # empty path
+        "Org=/x",                    # charset (names become labels)
+        "default=/x",                # reserved for the archive's bank
+        "orga=/x,orga=/y",           # duplicate
+        "",                          # names no tenants
+        ",,",
+    ):
+        with pytest.raises(TenantSpecError):
+            parse_tenant_spec(bad_spec)
+
+
+# -- isolation on one service -------------------------------------------------
+
+def test_two_tenant_isolation_and_ledger_on_one_service(tel):
+    assert BASE_A != BASE_B  # the observable-bleed premise
+    predictor, service = _make_service()
+    service.swap_bank(ORG_A_BANK, tenant="orga")
+    service.swap_bank(ORG_B_BANK, tenant="orgb")
+
+    expected = {"orga": BASE_A, "orgb": BASE_B, "default": 0.0}
+    futures = []
+    for i in range(8):
+        futures.append(("orga", service.submit(f"report {i}", tenant="orga")))
+        futures.append(("orgb", service.submit(f"report {i}", tenant="orgb")))
+        futures.append(("default", service.submit(f"report {i}")))
+    for tenant, future in futures:
+        response = future.result(timeout=10)
+        assert response["status"] == STATUS_OK
+        assert response["score"] == pytest.approx(
+            expected[tenant] + TOP, abs=1e-6
+        ), tenant
+        if tenant != "default":
+            prefix = "ALPHA-" if tenant == "orga" else "BETA-"
+            assert response["anchor"].startswith(prefix)
+
+    # an unknown tenant errors THAT request only — nothing queued
+    ghost = service.submit("x", tenant="ghost").result(timeout=5)
+    assert ghost["status"] == STATUS_ERROR and "ghost" in ghost["reason"]
+
+    service.drain()
+    counters = tel.snapshot()["counters"]
+    for tenant in ("orga", "orgb", "default"):
+        assert counters[f"serve.{tenant}.requests"] == 8
+        assert counters[f"serve.{tenant}.served"] == 8
+    assert counters["serve.ghost.requests"] == 1
+    assert counters["serve.ghost.errors"] == 1
+    _assert_tenant_ledger_sums(counters, ("default", "orga", "orgb", "ghost"))
+    # named swaps emit the per-tenant bank metrics, not the default's
+    assert counters["bank.orga.swaps"] == 1
+    assert counters["bank.orgb.swaps"] == 1
+    gauges = tel.snapshot()["gauges"]
+    assert gauges["bank.orga.version"] == 1
+    assert gauges["bank.orgb.version"] == 1
+
+    health = service.health_summary()
+    assert set(health["tenants"]) == {"orga", "orgb"}
+    assert health["tenants"]["orga"]["weighted"] is False
+    assert health["bank_version"] == 1  # default bank untouched
+
+
+def test_bank_resolve_fault_errors_one_request_only(tel):
+    predictor, service = _make_service()
+    service.swap_bank(ORG_A_BANK, tenant="orga")
+    faults.configure("bank.resolve=raise:RuntimeError:resolver down")
+    bad = service.submit("r0", tenant="orga").result(timeout=5)
+    assert bad["status"] == STATUS_ERROR
+    assert "resolver down" in bad["reason"]
+    # the clause fired once and disarmed: the next request serves fine
+    ok = service.submit("r1", tenant="orga").result(timeout=10)
+    assert ok["status"] == STATUS_OK
+    service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.errors"] == 1
+    assert counters["serve.orga.errors"] == 1
+    _assert_tenant_ledger_sums(counters, ("default", "orga"))
+
+
+# -- fleet: swap isolation + chaos --------------------------------------------
+
+def test_tenant_swap_never_changes_other_tenant_mid_load(tel):
+    router, replicas = _tenant_fleet(n=2)
+    try:
+        rolling_swap(router, ORG_A_BANK, tenant="orga")
+        rolling_swap(router, ORG_B_BANK, tenant="orgb")
+        default_version = router._active_version
+
+        stop = threading.Event()
+        b_responses = []
+
+        def hammer_b():
+            i = 0
+            while not stop.is_set():
+                b_responses.append(
+                    router.submit(f"b load {i}", tenant="orgb")
+                    .result(timeout=10)
+                )
+                i += 1
+
+        thread = threading.Thread(target=hammer_b)
+        thread.start()
+        time.sleep(0.05)
+        new_a = [
+            {"text1": f"alpha prime {i}", "meta": {"label": f"ALPHA2-{i}"}}
+            for i in range(3)
+        ]
+        rolling_swap(router, new_a, tenant="orga")
+        time.sleep(0.05)
+        stop.set()
+        thread.join(timeout=15)
+        assert b_responses and not thread.is_alive()
+
+        # not one B response moved: same bank version, same scores,
+        # through the entire A rollout
+        assert all(r["status"] == STATUS_OK for r in b_responses)
+        assert {r["bank_version"] for r in b_responses} == {1}
+        assert {round(r["score"], 6) for r in b_responses} == {
+            round(BASE_B + TOP, 6)
+        }
+        # the fleet's default version (what untagged requests pin to)
+        # never advanced
+        assert router._active_version == default_version
+
+        # A serves the new bank at its OWN next version
+        base_a2 = _bank_base([i["meta"]["label"] for i in new_a])
+        rolled = router.submit("post roll", tenant="orga").result(timeout=10)
+        assert rolled["bank_version"] == 2
+        assert rolled["score"] == pytest.approx(base_a2 + TOP, abs=1e-6)
+    finally:
+        router.drain()
+    snap = fleet_snapshot(replicas)
+    assert snap["invariant_ok"], snap
+    # per-replica, the per-tenant ledgers partition the replica's own
+    # counters — no request is attributed across the tenant boundary
+    for replica in replicas:
+        _assert_tenant_ledger_sums(replica.registry.snapshot()["counters"])
+
+
+@pytest.mark.chaos
+def test_replica_kill_mid_tenant_swap_recovers_both_banks(tel):
+    """The chaos arm: a replica is hard-killed while tenant A's rolling
+    swap is in flight.  The monitor restarts it, ``_sync_bank``
+    re-rolls BOTH named banks onto the rebuilt member, and the fleet
+    ends consistent: A on its new bank everywhere, B untouched."""
+    router, replicas = _tenant_fleet(n=2, max_reroutes=3)
+    new_a = [
+        {"text1": f"alpha prime {i}", "meta": {"label": f"ALPHA2-{i}"}}
+        for i in range(3)
+    ]
+    base_a2 = _bank_base([i["meta"]["label"] for i in new_a])
+    try:
+        rolling_swap(router, ORG_A_BANK, tenant="orga")
+        rolling_swap(router, ORG_B_BANK, tenant="orgb")
+        warm = [
+            router.submit(f"warm {i}", tenant="orgb").result(timeout=10)
+            for i in range(4)
+        ]
+        assert all(r["status"] == STATUS_OK for r in warm)
+
+        faults.configure("replica.kill.replica-0=raise:RuntimeError:chaos")
+        swapper = threading.Thread(
+            target=rolling_swap, args=(router, new_a),
+            kwargs={"tenant": "orga"},
+        )
+        swapper.start()
+        mid = []
+        while swapper.is_alive():
+            for tenant in ("orga", "orgb"):
+                mid.append(
+                    (tenant, router.submit(f"mid {len(mid)}", tenant=tenant)
+                     .result(timeout=15))
+                )
+        swapper.join(timeout=30)
+        assert not swapper.is_alive()
+        # the swap may finish before the router routes anything to the
+        # doomed member — keep driving load until the armed kill lands
+        deadline = time.monotonic() + 15
+        while (
+            time.monotonic() < deadline
+            and replicas[0].registry.counter("replica.kills").value == 0
+        ):
+            for tenant in ("orga", "orgb"):
+                mid.append(
+                    (tenant, router.submit(f"mid {len(mid)}", tenant=tenant)
+                     .result(timeout=15))
+                )
+        assert replicas[0].registry.counter("replica.kills").value == 1
+
+        # no hang, and — the bleed check — every OK response carries
+        # ITS tenant's score (old or new for A, exactly B's for B)
+        for tenant, response in mid:
+            assert response["status"] in (STATUS_OK, STATUS_ERROR)
+            if response["status"] != STATUS_OK:
+                continue
+            if tenant == "orgb":
+                assert response["score"] == pytest.approx(
+                    BASE_B + TOP, abs=1e-6
+                )
+                assert response["bank_version"] == 1
+            else:
+                assert response["score"] == pytest.approx(
+                    BASE_A + TOP, abs=1e-6
+                ) or response["score"] == pytest.approx(
+                    base_a2 + TOP, abs=1e-6
+                )
+
+        # wait out the restart, then prove the rebuilt member serves
+        # BOTH tenants' current banks (the _sync_bank re-roll)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and replicas[0].restart_count == 0:
+            time.sleep(0.02)
+        assert replicas[0].restart_count >= 1
+        deadline = time.monotonic() + 10
+        while (
+            time.monotonic() < deadline
+            and replicas[0].state != "healthy"
+        ):
+            time.sleep(0.02)
+        for replica in replicas:
+            got_a = replica.service.submit(
+                "direct a", tenant="orga"
+            ).result(timeout=10)
+            got_b = replica.service.submit(
+                "direct b", tenant="orgb"
+            ).result(timeout=10)
+            assert got_a["status"] == STATUS_OK, replica.name
+            assert got_a["score"] == pytest.approx(base_a2 + TOP, abs=1e-6)
+            assert got_a["bank_version"] == 2
+            assert got_b["status"] == STATUS_OK, replica.name
+            assert got_b["score"] == pytest.approx(BASE_B + TOP, abs=1e-6)
+            assert got_b["bank_version"] == 1
+    finally:
+        router.drain()
+    snap = fleet_snapshot(replicas)
+    assert snap["invariant_ok"], snap
+
+
+# -- startup plane: configure_tenants + promote/demote ------------------------
+
+ANCHORS_V1 = {
+    "CWE-79": "cross site scripting description",
+    "CWE-89": "sql injection description",
+    "CWE-22": "path traversal description",
+}
+
+
+def test_configure_tenants_installs_active_banks(tmp_path, tel):
+    store_a = BankStore(tmp_path / "orga")
+    store_a.create(ANCHORS_V1, source="build")
+    store_b = BankStore(tmp_path / "orgb")
+    store_b.create(ANCHORS_V1, source="build")
+    store_b.create({"CWE-502": "deserialization of untrusted data"})
+    store_b.set_active("v1")  # ACTIVE wins over latest
+
+    predictor, service = _make_service()
+    manager = configure_tenants(
+        service, f"orga={tmp_path / 'orga'},orgb={tmp_path / 'orgb'}"
+    )
+    try:
+        assert service.tenant_manager is manager
+        assert manager.tenants == ("orga", "orgb")
+        assert manager.live_version("orga") == "v1"
+        assert manager.live_version("orgb") == "v1"
+        banks = service.tenant_banks()
+        assert set(banks) == {"default", "orga", "orgb"}
+        assert banks["orga"].store_version == "v1"
+        assert banks["orga"].source == "startup"
+        summary = manager.summary()
+        assert summary["tenants"] == [
+            {"tenant": "orga", "store_version": "v1"},
+            {"tenant": "orgb", "store_version": "v1"},
+        ]
+        assert service.health_summary()["tenancy"] == summary
+        with pytest.raises(TenantSpecError):
+            manager.store("ghost")
+        # an empty store refuses loudly at startup
+        with pytest.raises(TenantSpecError):
+            configure_tenants(service, f"empty={tmp_path / 'empty'}")
+    finally:
+        service.drain()
+
+
+def test_promote_and_demote_tenant_scoped(tmp_path, tel):
+    store = BankStore(tmp_path / "orga")
+    store.create(ANCHORS_V1, source="build")
+    diff = BankDiff.from_json([
+        {"op": "add", "category": "CWE-502",
+         "description": "deserialization of untrusted data"},
+    ])
+    store.derive("v1", diff, note="rotate")
+    store.set_active("v1")  # serve v1; v2 is the promotion candidate
+
+    predictor, service = _make_service()
+    manager = configure_tenants(service, f"orga={tmp_path / 'orga'}")
+    try:
+        v1_score = service.submit("r", tenant="orga").result(timeout=10)
+        shadow = {"sampled": 200, "flips": 0, "flip_rate": 0.0}
+        approved = evaluate_gate(
+            {"auc": 0.9, "f1": 0.8}, {"auc": 0.9, "f1": 0.8},
+            shadow, candidate="v2", parent="v1",
+        )
+        serving_version = promote_tenant(
+            service, manager, "orga", approved, registry=tel
+        )
+        assert serving_version == 2
+        assert manager.live_version("orga") == "v2"
+        assert store.active()["version"] == "v2"
+        assert store.promotions()[-1]["tenant"] == "orga"
+        v2_score = service.submit("r", tenant="orga").result(timeout=10)
+        assert v2_score["bank_version"] == 2
+        assert v2_score["score"] != v1_score["score"]  # 4-anchor bank
+        # the default tenant's bank never moved
+        assert service.bank_version == 1
+
+        out = demote_tenant(service, manager, "orga", registry=tel)
+        assert out == {"version": "v1", "serving_version": 3}
+        assert manager.live_version("orga") == "v1"
+        restored = service.submit("r", tenant="orga").result(timeout=10)
+        assert restored["score"] == v1_score["score"]
+
+        refused = evaluate_gate(
+            {"auc": 0.9, "f1": 0.8}, {"auc": 0.5, "f1": 0.2},
+            shadow, candidate="v2", parent="v1",
+        )
+        with pytest.raises(PromotionRefused):
+            promote_tenant(service, manager, "orga", refused, registry=tel)
+        assert manager.live_version("orga") == "v1"  # refusal changes nothing
+        assert store.promotions()[-1]["kind"] == "promotion_refused"
+    finally:
+        service.drain()
+
+
+# -- admission cache ----------------------------------------------------------
+
+def test_cache_hit_is_bitwise_identical_and_skips_device(tel):
+    predictor, service = _make_service(cache_capacity=8)
+    try:
+        cold = service.submit("dup report").result(timeout=10)
+        assert cold["status"] == STATUS_OK and "cached" not in cold
+        calls = predictor.device_calls
+        warm = service.submit("dup report").result(timeout=10)
+        assert warm["status"] == STATUS_OK and warm["cached"] is True
+        assert predictor.device_calls == calls  # the hit never dispatched
+        for field in ("predict", "score", "anchor", "bank_version"):
+            assert warm[field] == cold[field], field
+        # a DIFFERENT text is a miss, not a false hit
+        other = service.submit("dup report!").result(timeout=10)
+        assert "cached" not in other
+    finally:
+        service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["cache.hits"] == 1
+    assert counters["cache.misses"] == 2
+    assert counters["cache.tokens_saved"] >= 1
+    # a hit is SERVED: the exact-counter invariant keeps summing
+    assert counters["serve.served"] == 3 == counters["serve.requests"]
+
+
+def test_cache_lru_eviction_is_bounded(tel):
+    predictor, service = _make_service(cache_capacity=1)
+    try:
+        for text in ("a report", "b report", "a report"):
+            assert service.submit(text).result(timeout=10)["status"] == STATUS_OK
+        assert len(service.admission_cache) == 1
+    finally:
+        service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters.get("cache.hits", 0) == 0  # "a" was evicted by "b"
+    assert counters["cache.misses"] == 3
+    assert counters["cache.evictions"] >= 1
+    assert tel.snapshot()["gauges"]["cache.size"] == 1
+
+
+def test_cache_invalidation_is_per_tenant_on_swap(tel):
+    predictor, service = _make_service(cache_capacity=8)
+    try:
+        service.swap_bank(ORG_A_BANK, tenant="orga")
+        service.swap_bank(ORG_B_BANK, tenant="orgb")
+        for tenant in ("orga", "orgb"):
+            first = service.submit("t", tenant=tenant).result(timeout=10)
+            assert "cached" not in first
+            assert service.submit("t", tenant=tenant).result(timeout=10)[
+                "cached"
+            ] is True
+        # swap ONLY orgb: orga's entry must survive, orgb's must not
+        new_b = [
+            {"text1": f"beta prime {i}", "meta": {"label": f"BETA2-{i}"}}
+            for i in range(3)
+        ]
+        service.swap_bank(new_b, tenant="orgb")
+        still_a = service.submit("t", tenant="orga").result(timeout=10)
+        assert still_a["cached"] is True
+        fresh_b = service.submit("t", tenant="orgb").result(timeout=10)
+        assert "cached" not in fresh_b
+        assert fresh_b["bank_version"] == 2
+        assert fresh_b["score"] == pytest.approx(
+            _bank_base([i["meta"]["label"] for i in new_b]) + TOP, abs=1e-6
+        )
+    finally:
+        service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["cache.invalidations"] >= 1
+
+
+def test_cache_lookup_fault_degrades_to_miss(tel):
+    predictor, service = _make_service(cache_capacity=8)
+    try:
+        first = service.submit("c report").result(timeout=10)
+        faults.configure("cache.lookup=raise:RuntimeError:cache on fire")
+        degraded = service.submit("c report").result(timeout=10)
+        # a broken cache costs a device call, never the request
+        assert degraded["status"] == STATUS_OK
+        assert "cached" not in degraded
+        assert degraded["score"] == first["score"]
+        # the clause disarmed: the next repeat hits again
+        assert service.submit("c report").result(timeout=10)["cached"] is True
+    finally:
+        service.drain()
+    counters = tel.snapshot()["counters"]
+    assert counters["cache.errors"] == 1
+    assert counters["cache.hits"] == 1
+    assert counters["serve.served"] == 3 == counters["serve.requests"]
+
+
+def test_slo_harness_dedup_load_reports_cache_block(tel):
+    predictor, service = _make_service(
+        cache_capacity=64, default_deadline_ms=30000.0
+    )
+    try:
+        record = run_slo_harness(
+            service,
+            [f"text {i}" for i in range(16)],
+            config=LoadConfig(
+                pattern="dedup", requests=64, rps=2000.0,
+                dedup_unique=4, seed=3,
+            ),
+        )
+    finally:
+        service.drain()
+    assert record["load"]["outcomes"]["hang"] == 0
+    cache = record["cache"]
+    assert cache["hits"] > 0
+    assert cache["hits"] + cache["misses"] == 64
+    assert cache["hit_rate"] == pytest.approx(cache["hits"] / 64, abs=1e-4)
+    assert cache["device_calls_avoided"] == cache["hits"]
+    # 4 unique texts: misses are the uniques plus the handful of
+    # same-text requests racing the first store of their batch window
+    assert cache["hit_rate"] >= 0.5
+
+
+# -- loadgen dedup pattern ----------------------------------------------------
+
+def test_loadgen_dedup_pattern_is_seeded_and_skewed():
+    texts = [f"text {i}" for i in range(50)]
+    cfg = LoadConfig(pattern="dedup", requests=200, dedup_unique=8, seed=7)
+    first = request_texts(cfg, texts)
+    assert first == request_texts(cfg, texts)  # deterministic in the seed
+    assert len(first) == 200
+    assert set(first) <= set(texts[:8])  # draws only from the head pool
+    counts = sorted(
+        (first.count(t) for t in set(first)), reverse=True
+    )
+    assert counts[0] > 200 // 8  # Zipf-ish head skew, repeats guaranteed
+    assert request_texts(
+        dataclasses.replace(cfg, seed=8), texts
+    ) != first
+    prefixed = request_texts(
+        dataclasses.replace(cfg, template_prefix="TPL: "), texts
+    )
+    assert all(t.startswith("TPL: ") for t in prefixed)
+    # non-dedup patterns keep the pre-existing round-robin schedule
+    assert request_texts(
+        LoadConfig(pattern="poisson", requests=5), texts
+    ) == texts[:5]
+    with pytest.raises(ValueError):
+        request_texts(cfg, [])
+
+
+# -- reweight gate ------------------------------------------------------------
+
+class _MatrixPredictor:
+    """``evaluate_reweight`` surface: a fixed per-text probability row."""
+
+    def __init__(self, probs):
+        self.probs = {t: np.asarray(row, np.float32) for t, row in probs.items()}
+
+    def encode_bank(self, instances):
+        labels = [inst["meta"]["label"] for inst in instances]
+        return np.zeros((len(labels), 2), np.float32), labels, len(labels)
+
+    def warmup_bank_shapes(self, bank):
+        pass
+
+    def score_texts(self, texts, bank, n_anchors):
+        return np.stack([self.probs[t] for t in texts])
+
+
+def _reweight_fixture(tmp_path):
+    store = BankStore(tmp_path / "banks")
+    store.create(ANCHORS_V1, source="build")
+    diff = BankDiff.from_json([
+        {"op": "reweight", "category": "CWE-89", "weight": 4.0},
+    ])
+    store.derive("v1", diff, note="boost sqli")
+    labels = [inst["meta"]["label"] for inst in store.instances("v1")]
+    strong, boosted = labels.index("CWE-79"), labels.index("CWE-89")
+
+    def row(values):
+        out = [0.1] * len(labels)
+        for idx, v in values.items():
+            out[idx] = v
+        return out
+
+    probs, instances = {}, []
+    for i in range(4):  # positives: plain winner 0.6, boosted anchor 0.2
+        text = f"pos {i}"
+        probs[text] = row({strong: 0.6, boosted: 0.2})
+        instances.append({"text1": text, "meta": {"label": "CWE-79"}})
+    for i in range(4):  # negatives: everything low
+        text = f"neg {i}"
+        probs[text] = row({strong: 0.2, boosted: 0.05})
+        instances.append({"text1": text, "meta": {"label": "neg"}})
+    return store, _MatrixPredictor(probs), instances
+
+
+def test_reweight_all_ones_is_parity_anchor(tmp_path):
+    store, predictor, instances = _reweight_fixture(tmp_path)
+    decision = evaluate_reweight(
+        predictor, store, "v1", instances,
+        thresholds=GateThresholds(min_shadow_samples=1),
+    )
+    assert decision.approved, decision.reasons
+    assert decision.candidate == "v1+reweight"
+    shadow = decision.metrics["shadow"]
+    assert shadow["flips"] == 0
+    assert shadow["anchor_changes"] == 0
+    assert shadow["max_abs_delta"] == 0.0  # weighted selection == argmax
+    assert decision.metrics["active"] == decision.metrics["candidate"]
+
+
+def test_reweight_skewed_weights_flip_and_refuse(tmp_path):
+    store, predictor, instances = _reweight_fixture(tmp_path)
+    # v2 boosts CWE-89 4x: every positive's weighted winner moves to the
+    # 0.2 anchor, crossing the 0.5 decision threshold — 4 flips / 8
+    decision = evaluate_reweight(
+        predictor, store, "v2", instances,
+        thresholds=GateThresholds(min_shadow_samples=1),
+    )
+    assert not decision.approved
+    assert REASON_FLIP_RATE in [r["code"] for r in decision.reasons]
+    shadow = decision.metrics["shadow"]
+    assert shadow["flips"] == 4
+    assert shadow["anchor_changes"] == 4
+    assert shadow["max_abs_delta"] == pytest.approx(0.4, abs=1e-6)
+
+
+def test_reweight_refuses_misaligned_weights(tmp_path):
+    store, predictor, instances = _reweight_fixture(tmp_path)
+
+    class _Misaligned(_MatrixPredictor):
+        def encode_bank(self, inner):
+            inner = list(inner)
+            labels = [inst["meta"]["label"] for inst in inner][:-1]
+            return np.zeros((len(labels), 2), np.float32), labels, len(labels)
+
+    with pytest.raises(BankStoreError):
+        evaluate_reweight(
+            _Misaligned(predictor.probs), store, "v1", instances,
+            thresholds=GateThresholds(min_shadow_samples=1),
+        )
+
+
+def test_weighted_bank_serves_weighted_winner_raw_score(tel):
+    """End to end: a served response's winner uses the weighted argmax,
+    its reported score is the RAW probability of that winner — and a
+    weight-1.0 bank is bitwise the unweighted path (weights=None)."""
+    predictor, service = _make_service()
+    try:
+        plain = [
+            {"text1": f"a{i}", "meta": {"label": f"W-{i}", "weight": 1.0}}
+            for i in range(3)
+        ]
+        service.swap_bank(plain, tenant="orga")
+        assert service.tenant_banks()["orga"].weights is None
+        response = service.submit("r", tenant="orga").result(timeout=10)
+        # linspace scoring: the last anchor wins unweighted
+        assert response["anchor"] == "W-2"
+
+        boosted = [
+            {"text1": f"a{i}",
+             "meta": {"label": f"W-{i}", "weight": 9.0 if i == 0 else 1.0}}
+            for i in range(3)
+        ]
+        service.swap_bank(boosted, tenant="orga")
+        bank = service.tenant_banks()["orga"]
+        assert bank.weights is not None
+        weighted = service.submit("r", tenant="orga").result(timeout=10)
+        assert weighted["anchor"] == "W-0"  # the boosted anchor wins...
+        # ...but the reported score is its raw probability, not 9x it
+        assert weighted["score"] == pytest.approx(
+            weighted["predict"]["W-0"], abs=0
+        )
+        assert weighted["score"] < weighted["predict"]["W-2"]
+        assert service.health_summary()["tenants"]["orga"]["weighted"] is True
+    finally:
+        service.drain()
+
+
+# -- prefix share -------------------------------------------------------------
+
+def test_pack_slot_allocator_aliases_exact_duplicates():
+    shared = PackSlotAllocator(
+        token_budget=16, max_rows=8, pad_id=0, share_prefixes=True
+    )
+    seq = [5, 6, 7]
+    assert (shared.admit(seq), shared.admit(seq), shared.admit([8, 9])) == (
+        0, 1, 2,
+    )
+    assert shared.rows_aliased == 1 and shared.tokens_aliased == 3
+    assert shared.real_tokens == 5  # the duplicate wrote NOTHING
+    sample = shared.sample()
+    # the aliased row's pooling gather reads the original's CLS slot
+    assert sample["row_starts"][1] == sample["row_starts"][0]
+    assert sample["row_starts"][2] != sample["row_starts"][0]
+    # a reset recycles the segment index: the next pack re-writes
+    shared.reset()
+    assert shared.admit(seq) == 0
+    assert shared.real_tokens == 3
+    assert shared.rows_aliased == 1  # cumulative counter, no new alias
+
+    # an alias needs only a ROW slot — it is admitted even with the
+    # token budget exhausted
+    tight = PackSlotAllocator(
+        token_budget=4, max_rows=4, pad_id=0, share_prefixes=True
+    )
+    assert tight.admit([1, 2, 3, 4]) == 0
+    assert tight.fits([1, 2, 3, 4]) and tight.admit([1, 2, 3, 4]) == 1
+    assert tight.admit([9]) is None  # real tokens no longer fit
+
+    # off by default: every row pays its tokens
+    plain = PackSlotAllocator(token_budget=16, max_rows=8, pad_id=0)
+    plain.admit(seq)
+    plain.admit(seq)
+    assert plain.rows_aliased == 0 and plain.real_tokens == 6
+
+
+class _ContinuousFake:
+    """Continuous-dispatch predictor whose score is a function of the
+    POOLED token each row's ``row_starts`` points at — an aliasing bug
+    (wrong gather offset) changes the score, so the ≤1e-6 parity
+    assertion is sensitive to the segment-table bookkeeping."""
+
+    score_impl = "continuous"
+
+    def __init__(self, n_anchors=3, rows=8, budget=64, length=8):
+        self.encoder = _CharEncoder(length)
+        self.mesh = None
+        self.params = None
+        self.n_anchors = n_anchors
+        self.anchor_labels = [f"A{i}" for i in range(n_anchors)]
+        self.anchor_bank = np.zeros((n_anchors, 2), np.float32)
+        self.score_trace_count = 0
+        self._rows = rows
+        self._budget = budget
+        self._shapes = [(rows, length)]
+        self.started = threading.Event()
+        self.hold = threading.Event()
+
+    def stream_shapes(self):
+        return list(self._shapes)
+
+    def ragged_shape(self):
+        return (self._budget, self._rows)
+
+    def encode_bank(self, instances):
+        instances = list(instances)
+        labels = [inst["meta"]["label"] for inst in instances]
+        bank = np.full((len(labels), 2), _bank_base(labels), np.float32)
+        return bank, labels, len(labels)
+
+    def warmup_bank_shapes(self, bank):
+        pass
+
+    def _ragged_score_fn(self, params, sample, bank):
+        self.started.set()
+        assert self.hold.wait(timeout=30), "test forgot to release hold"
+        ids = sample["input_ids"][0]
+        starts = sample["row_starts"]
+        base = float(bank[0, 0])
+        out = np.zeros((self._rows, bank.shape[0]), np.float32)
+        for r in range(self._rows):
+            pooled = float(ids[int(starts[r])]) / 1000.0
+            out[r] = base + pooled + np.linspace(
+                0.0, 0.05, bank.shape[0], dtype=np.float32
+            )
+        return out
+
+
+def _run_continuous(prefix_share, texts):
+    fake = _ContinuousFake()
+    fake.hold.set()  # warmup request flows straight through
+    service = ScoringService(
+        fake,
+        config=ServiceConfig(
+            max_batch=8, max_wait_ms=1.0, prefix_share=prefix_share,
+        ),
+    )
+    try:
+        # block the device on a warmup pack so the real texts accumulate
+        # into ONE open pack (aliasing only applies within a pack)
+        fake.hold.clear()
+        fake.started.clear()
+        warm = service.submit("warmup text")
+        assert fake.started.wait(timeout=10)
+        futures = [service.submit(t) for t in texts]
+        time.sleep(0.1)  # let admission alias/write every row
+        fake.hold.set()
+        warm.result(timeout=10)
+        return [f.result(timeout=10) for f in futures]
+    finally:
+        service.drain()
+
+
+def test_prefix_share_parity_and_measured_savings(tel):
+    texts = ["template body"] * 4 + ["unique one", "other text"]
+    unshared = _run_continuous(False, texts)
+    counters = tel.snapshot()["counters"]
+    assert "serve.prefix_rows_aliased" not in counters  # off by default
+    shared = _run_continuous(True, texts)
+    assert all(r["status"] == STATUS_OK for r in unshared + shared)
+    for a, b in zip(unshared, shared):
+        assert abs(a["score"] - b["score"]) <= 1e-6
+        for label in a["predict"]:
+            assert abs(a["predict"][label] - b["predict"][label]) <= 1e-6
+    # identical texts share one row's tokens — the measured win
+    counters = tel.snapshot()["counters"]
+    assert counters["serve.prefix_rows_aliased"] >= 3
+    assert counters["serve.prefix_tokens_saved"] >= 3
