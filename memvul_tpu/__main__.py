@@ -16,6 +16,7 @@ scripts (reference: README.md:130-147).  Here everything is one CLI:
     python -m memvul_tpu bank build --store banks/ --anchors data/CWE_anchor_golden_project.json
     python -m memvul_tpu telemetry-report out/
     python -m memvul_tpu lint --json
+    python -m memvul_tpu tune --out profiles/ --cascade
     python -m memvul_tpu doctor
     python -m memvul_tpu parity --hf-dir bert-base-uncased
     python -m memvul_tpu selfcheck
@@ -654,6 +655,80 @@ def cmd_telemetry_report(args) -> int:
     return 0
 
 
+def cmd_tune(args) -> int:
+    """Offline autotuner (docs/tuning.md): sweep the knob space for
+    this device class, prune analytically, microbench survivors behind
+    the mandatory parity gate, and persist the versioned tuned profile.
+    ``--report`` renders the measured roofline markdown instead.  Exit
+    0 = tuned (record on stdout), 1 = run produced no usable winner,
+    2 = usage / machine-readable ``unknown_device_class`` refusal."""
+    if args.report is not None:
+        from .tuning.report import (
+            report_from_programs_json,
+            splice_generated_section,
+        )
+
+        path = Path(args.report)
+        if path.is_dir():
+            path = path / "programs.json"
+        if not path.is_file():
+            print(
+                f"tune --report: {path} not found (pass a run dir that "
+                "wrote programs.json, or the file itself)",
+                file=sys.stderr,
+            )
+            return 2
+        md = report_from_programs_json(path)
+        if args.splice:
+            doc = Path(args.splice)
+            if not doc.is_file():
+                print(f"tune --splice: {doc} not found", file=sys.stderr)
+                return 2
+            doc.write_text(splice_generated_section(doc.read_text(), md))
+            print(f"tune: generated section spliced into {doc}",
+                  file=sys.stderr)
+        print(md)
+        return 0
+
+    from .tuning.autotune import run_tune
+
+    bench_kwargs = dict(
+        seed=args.seed, model_size=args.model, seq_len=args.seq_len,
+        batch_size=args.batch_size, steps_per_epoch=args.steps,
+        n_requests=args.requests, n_clients=args.clients,
+        max_batch=args.max_batch,
+    )
+    # the full grids are a silicon-budget sweep; the default is the
+    # slim grid (same axes, fewer points) so a CPU run stays in minutes
+    train_space_kwargs = None if args.full_space else dict(
+        bucket_grids=[None, "pow2"], dedup_options=(True,),
+        prefetch_depths=(2, 8),
+    )
+    serve_space_kwargs = None if args.full_space else dict(
+        wait_ms_options=(2.0, 5.0), budget_factors=(2, 4),
+        rows_factors=(1,),
+    )
+    record = run_tune(
+        args.mode,
+        device_class=args.device_class,
+        allow_unknown_device=args.allow_unknown_device,
+        out_dir=args.out,
+        cascade=args.cascade,
+        target_rescore_rate=args.target_rescore_rate,
+        max_programs=args.max_programs,
+        hbm_fraction=args.hbm_fraction,
+        bench_kwargs=bench_kwargs,
+        train_space_kwargs=train_space_kwargs,
+        serve_space_kwargs=serve_space_kwargs,
+    )
+    print(json.dumps(record, indent=2, default=float))
+    if record.get("error") == "unknown_device_class":
+        return 2
+    # a tune that found NO parity-passing winner anywhere leaves the
+    # defaults in place — report it as a failed run, not silent success
+    return 0 if record.get("profile") else 1
+
+
 def cmd_doctor(args) -> int:
     """Environment/artifact self-diagnosis (utils/doctor.py)."""
     from .utils.doctor import run_doctor
@@ -1019,6 +1094,69 @@ def build_parser() -> argparse.ArgumentParser:
                    help="emit the machine-readable report (stable schema "
                    "— the lint --json pattern) instead of the table text")
     p.set_defaults(fn=cmd_telemetry_report)
+
+    p = sub.add_parser(
+        "tune",
+        help="offline autotuner (docs/tuning.md): sweep training/serving "
+        "performance knobs for this device class, prune infeasible "
+        "points through the program registry's cost/memory analysis, "
+        "microbench survivors behind the mandatory parity gate, and "
+        "persist a versioned, checksummed tuned profile the build "
+        "entry points load by default; --cascade tunes the rescue "
+        "band, --report renders the measured roofline table",
+    )
+    p.add_argument("--mode", choices=("train", "serve", "all"),
+                   default="all", help="which knob families to sweep")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="tuned-profile store root (tuning.profile_dir / "
+                   "$MEMVUL_TUNED_PROFILES layout); omit for a dry run")
+    p.add_argument("--cascade", action="store_true",
+                   help="also tune [cascade_low, cascade_high] from the "
+                   "golden set's int8 score distribution, gated through "
+                   "bankops.evaluate_cascade")
+    p.add_argument("--target-rescore-rate", type=float, default=0.1,
+                   help="golden-set fraction the cascade band should "
+                   "send to the fp32 rescue tier")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="render the measured roofline markdown from a "
+                   "run dir's programs.json instead of tuning")
+    p.add_argument("--splice", default=None, metavar="DOC",
+                   help="with --report: splice the generated section "
+                   "into this markdown doc in place")
+    p.add_argument("--device-class", default=None,
+                   help="tune for this device class instead of the "
+                   "default backend's (e.g. 'tpu v5 lite')")
+    p.add_argument("--allow-unknown-device", action="store_true",
+                   help="tune a class with no PEAK_SPECS row in "
+                   "measurement-only mode (analytic HBM pruning "
+                   "skipped) instead of the unknown_device_class "
+                   "refusal — how CPU harness records are produced")
+    p.add_argument("--max-programs", type=int, default=64,
+                   help="analytic prune ceiling: worst-case compiled-"
+                   "program count per candidate")
+    p.add_argument("--hbm-fraction", type=float, default=0.9,
+                   help="analytic prune ceiling: fraction of the device "
+                   "class's HBM capacity a candidate may project")
+    p.add_argument("--full-space", action="store_true",
+                   help="sweep the full knob grids (silicon budget) "
+                   "instead of the slim default")
+    p.add_argument("--model", choices=("tiny", "base"), default="tiny",
+                   help="microbench model geometry (base is the one "
+                   "that means something on hardware)")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="training microbench batch size")
+    p.add_argument("--steps", type=int, default=4,
+                   help="training microbench optimizer steps per epoch")
+    p.add_argument("--requests", type=int, default=96,
+                   help="serving microbench request count")
+    p.add_argument("--clients", type=int, default=4,
+                   help="serving microbench closed-loop client threads")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="serving default micro-batch cap (the sweep "
+                   "center)")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser(
         "doctor",

@@ -56,7 +56,11 @@ vs real-token throughput for both paths,
 BENCH_TRAIN_{STEPS,BATCH,ACCUM} set the load — docs/training_throughput.md;
 corpus — sharded full-corpus scoring through the supervised worker fleet,
 BENCH_CORPUS_SHARDS/BENCH_CORPUS_REPORTS set the shape —
-docs/full_corpus.md),
+docs/full_corpus.md;
+tune — run the offline autotuner in-process (docs/tuning.md) and emit
+one tuned-vs-default record over the train_step and serve microbenches
+with the parity-gate evidence, BENCH_TUNE=1 is an alias,
+BENCH_TUNE_MODE/BENCH_TUNE_CASCADE/BENCH_TUNE_OUT steer it),
 BENCH_PHASE_TIMEOUT (per-phase watchdog deadline inside the child,
 default 600 s, 0 disables — a stuck phase emits a parseable JSON
 failure record naming the phase, its last-heartbeat age (stuck phase vs
@@ -113,6 +117,8 @@ _CHILD_ENV_FLAG = "MEMVUL_BENCH_CHILD"
 
 def _metric_name() -> str:
     micro = os.environ.get("BENCH_MICRO")
+    if not micro and os.environ.get("BENCH_TUNE") == "1":
+        micro = "tune"  # BENCH_TUNE=1 alias for BENCH_MICRO=tune
     return f"{micro}_microbench" if micro else "siamese_scoring_throughput"
 
 
@@ -257,10 +263,16 @@ def _run_bench() -> None:
     if os.environ.get("BENCH_MICRO") == "corpus":
         _run_corpus_micro()
         return
+    if os.environ.get("BENCH_MICRO") == "tune" or (
+        not os.environ.get("BENCH_MICRO")
+        and os.environ.get("BENCH_TUNE") == "1"
+    ):
+        _run_tune_micro()
+        return
     if os.environ.get("BENCH_MICRO"):
         raise ValueError(
             f"unknown BENCH_MICRO mode {os.environ['BENCH_MICRO']!r} "
-            "(known: anchor_match, corpus, serve, train_step)"
+            "(known: anchor_match, corpus, serve, train_step, tune)"
         )
     import numpy as np
     import jax
@@ -687,6 +699,124 @@ def _run_train_step_micro() -> None:
             }
         )
     )
+
+
+def _run_tune_micro() -> None:
+    """BENCH_MICRO=tune (or BENCH_TUNE=1): the offline autotuner as a
+    bench leg (docs/tuning.md) — one tuned-vs-default JSON record for
+    the chip-window sweep.
+
+    Runs :func:`memvul_tpu.tuning.autotune.run_tune` in-process over
+    the slim knob grids, then reports the tuned winner against the
+    hand-set defaults on BOTH microbenches: real-token train throughput
+    (the train_step harness contract) and serve requests/sec, with the
+    parity-gate refusal counts proving scores were never traded for
+    speed.  The headline ``value`` is the geometric mean of the
+    available tuned/default speedups.
+
+    Knobs: BENCH_MODEL (tiny | base), BENCH_SEQ_LEN,
+    BENCH_TUNE_MODE (train | serve | all, default all),
+    BENCH_TUNE_CASCADE=1 (also tune the rescue band),
+    BENCH_TUNE_OUT (persist the tuned profile store there),
+    BENCH_TRAIN_STEPS / BENCH_TRAIN_BATCH (training microbench load),
+    BENCH_MICRO_REQUESTS / BENCH_MICRO_CLIENTS /
+    BENCH_SERVE_MAX_BATCH (serving microbench load).
+    """
+    from memvul_tpu.utils.platform import enable_compilation_cache, honor_platform_env
+
+    honor_platform_env()
+    enable_compilation_cache()
+
+    from memvul_tpu.tuning.autotune import run_tune
+
+    watchdog = _watchdog()
+    mode = os.environ.get("BENCH_TUNE_MODE", "all")
+    bench_kwargs = dict(
+        seed=0,
+        model_size=os.environ.get("BENCH_MODEL", "tiny"),
+        seq_len=int(os.environ.get("BENCH_SEQ_LEN", "128")),
+        batch_size=int(os.environ.get("BENCH_TRAIN_BATCH", "8")),
+        steps_per_epoch=int(os.environ.get("BENCH_TRAIN_STEPS", "4")),
+        n_requests=int(os.environ.get("BENCH_MICRO_REQUESTS", "96")),
+        n_clients=int(os.environ.get("BENCH_MICRO_CLIENTS", "4")),
+        max_batch=int(os.environ.get("BENCH_SERVE_MAX_BATCH", "8")),
+    )
+    with watchdog.phase("tune_sweep"):
+        record = run_tune(
+            mode,
+            allow_unknown_device=True,  # CPU harness: measurement-only
+            out_dir=os.environ.get("BENCH_TUNE_OUT") or None,
+            cascade=os.environ.get("BENCH_TUNE_CASCADE") == "1",
+            bench_kwargs=bench_kwargs,
+            train_space_kwargs=dict(
+                bucket_grids=[None, "pow2"], dedup_options=(True,),
+                prefetch_depths=(2, 8),
+            ),
+            serve_space_kwargs=dict(
+                wait_ms_options=(2.0, 5.0), budget_factors=(2, 4),
+                rows_factors=(1,),
+            ),
+        )
+
+    speedups = [
+        s for s in (
+            (record.get("train") or {}).get("speedup_real_tokens"),
+            (record.get("serve") or {}).get("speedup_rps"),
+        ) if s
+    ]
+    value = round(
+        float(np_geomean(speedups)) if speedups else 0.0, 3
+    )
+
+    def _leg(section, metric_key):
+        block = record.get(section) or {}
+        winner = block.get("winner") or {}
+        return {
+            "default_knobs": block.get("default_knobs"),
+            "default": block.get("default_bench"),
+            "tuned_knobs": (
+                winner.get("prune", {}).get("candidate", {}).get("knobs")
+            ),
+            "tuned": winner.get("bench"),
+            "speedup": block.get(metric_key),
+            "parity": (winner.get("parity") or {}).get("passed"),
+        }
+
+    parity_refused = sum(
+        1
+        for section in ("train", "serve")
+        for row in (record.get(section) or {}).get("candidates", [])
+        if row.get("parity") and not row["parity"]["passed"]
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "tune_microbench",
+                "value": value,
+                "unit": "x geomean(tuned/default: train real-tokens, serve rps)",
+                "vs_baseline": 0.0,  # no external tuning baseline (BASELINE.md)
+                "device_class": record.get("device_class"),
+                "mode": mode,
+                "train": _leg("train", "speedup_real_tokens"),
+                "serve": _leg("serve", "speedup_rps"),
+                "cascade": record.get("cascade"),
+                "parity_refused": parity_refused,
+                "profile_path": record.get("profile_path"),
+                "config": record.get("bench"),
+                **_program_blocks(),
+            }
+        )
+    )
+
+
+def np_geomean(values):
+    """Geometric mean without importing numpy at module scope."""
+    import math
+
+    vals = [float(v) for v in values if v and v > 0]
+    if not vals:
+        return 0.0
+    return math.exp(sum(math.log(v) for v in vals) / len(vals))
 
 
 def _run_serve_micro() -> None:

@@ -271,9 +271,16 @@ def train_from_config(
             )
 
     from .config import validate_training_config
+    from .tuning.profile import apply_tuned_trainer
 
-    # fail on a bad feed depth / bucket grid here, not minutes into epoch 0
-    trainer_cfg = validate_training_config(config.get("trainer"))
+    # overlay the device class's tuned profile UNDER the explicit
+    # trainer section (docs/tuning.md: explicit config always wins; no
+    # configured profile store → the dict passes through untouched),
+    # then fail on a bad feed depth / bucket grid here, not minutes
+    # into epoch 0 — tuned knobs get exactly the same validation
+    trainer_cfg = validate_training_config(
+        apply_tuned_trainer(dict(config.get("trainer") or {}), config)
+    )
     trainer_cfg.setdefault("seed", seed)
     trainer_cfg["serialization_dir"] = str(serialization_dir)
     if tel_cfg["trace_dir"] and not trainer_cfg.get("profile_dir"):
@@ -407,6 +414,15 @@ def serve_from_archive(
             step_events=bool(tel_cfg["step_events"]),
         )
     serve_cfg = serving_config(arch.config)
+    # overlay the device class's tuned profile UNDER the archive's
+    # explicit serving section (docs/tuning.md): a key the archive (or
+    # overrides) wrote non-null always wins; tuned knobs fill the rest,
+    # BEFORE the validation below so they answer to the same checks
+    from .tuning.profile import apply_tuned_serving
+
+    serve_cfg = apply_tuned_serving(
+        serve_cfg, arch.config.get("serving") or {}, arch.config
+    )
     max_length = int(serve_cfg["max_length"])
     model_positions = getattr(
         getattr(arch.model, "config", None), "max_position_embeddings", None

@@ -496,3 +496,38 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
 def telemetry_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     """``cfg["telemetry"]`` merged over :data:`TELEMETRY_DEFAULTS`."""
     return _section_over_defaults(cfg, "telemetry", TELEMETRY_DEFAULTS)
+
+
+# The ``tuning`` config section (docs/tuning.md) — the offline
+# autotuner.  Read in two places: ``python -m memvul_tpu tune`` (the
+# sweep knobs) and the build entry points (profile loading:
+# ``build.train_from_config`` / ``serve_from_archive`` overlay the
+# device class's tuned profile UNDER any explicit trainer/serving
+# config — explicit keys always win, and with no profile store
+# configured the merged config is byte-identical to pre-tuner builds).
+TUNING_DEFAULTS: Dict[str, Any] = {
+    "enabled": True,         # load tuned profiles in the build entry points
+    # tuned-profile store root (tuning/profile.py layout:
+    # <dir>/<device_class>/profile-NNNN.json + MANIFEST.json).  None
+    # falls back to $MEMVUL_TUNED_PROFILES, then to no loading at all
+    "profile_dir": None,
+    # tune for a specific device class instead of the default backend's
+    # (normalized device_kind, e.g. "tpu_v5_lite"); None = autodetect
+    "device_class": None,
+    # cascade band autotuner (tune --cascade): fraction of golden-set
+    # rows the chosen [cascade_low, cascade_high] band should send to
+    # the fp32 rescue tier
+    "target_rescore_rate": 0.1,
+    # analytic pruning ceilings (tuning/prune.py): candidates whose
+    # worst-case compiled-program count or projected HBM footprint
+    # exceed these are refused before any microbench spend
+    "max_programs": 64,
+    "hbm_fraction": 0.9,     # of the device class's PEAK_SPECS hbm_bytes
+    # fixed probe-set size for the parity gate's score evidence
+    "parity_probe": 32,
+}
+
+
+def tuning_config(cfg: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``cfg["tuning"]`` merged over :data:`TUNING_DEFAULTS`."""
+    return _section_over_defaults(cfg, "tuning", TUNING_DEFAULTS)

@@ -50,16 +50,31 @@ from typing import Any, Dict, List, Optional, Tuple
 from .registry import get_registry
 
 # Peak specs for the roofline denominators (docs/roofline_train.md):
-# dense bf16 FLOP/s and HBM bandwidth per chip, keyed by a lowercase
-# substring of jax's ``device_kind``.  Small on purpose — an unknown
-# device (and every CPU) renders as interpret-only rather than against
-# a made-up peak.
+# dense bf16 FLOP/s, HBM bandwidth, and HBM capacity per chip, keyed by
+# a lowercase substring of jax's ``device_kind``.  Small on purpose —
+# an unknown device (and every CPU) renders as interpret-only rather
+# than against a made-up peak.  ``hbm_bytes`` is the capacity ceiling
+# the offline autotuner's analytic pruner checks candidate footprints
+# against (tuning/prune.py); roofline() itself only reads the two rate
+# rows.  Order matters: substring matching means the more specific
+# marker must precede its prefix ("v5 lite"/"v5p" before "v5e" is
+# irrelevant, but "v2"/"v3"/"v4" must not shadow "v5*" — they cannot,
+# dict order is first-match and the v5 rows come first).
 PEAK_SPECS: Dict[str, Dict[str, float]] = {
-    "v5 lite": {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9},
-    "v5e": {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9},
-    "v5p": {"flops_per_s": 459e12, "hbm_bytes_per_s": 2765e9},
-    "v4": {"flops_per_s": 275e12, "hbm_bytes_per_s": 1228e9},
-    "v6e": {"flops_per_s": 918e12, "hbm_bytes_per_s": 1640e9},
+    "v5 lite": {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9,
+                "hbm_bytes": 16e9},
+    "v5e": {"flops_per_s": 197e12, "hbm_bytes_per_s": 819e9,
+            "hbm_bytes": 16e9},
+    "v5p": {"flops_per_s": 459e12, "hbm_bytes_per_s": 2765e9,
+            "hbm_bytes": 95e9},
+    "v6e": {"flops_per_s": 918e12, "hbm_bytes_per_s": 1640e9,
+            "hbm_bytes": 32e9},
+    "v4": {"flops_per_s": 275e12, "hbm_bytes_per_s": 1228e9,
+           "hbm_bytes": 32e9},
+    "v3": {"flops_per_s": 123e12, "hbm_bytes_per_s": 900e9,
+           "hbm_bytes": 32e9},
+    "v2": {"flops_per_s": 45e12, "hbm_bytes_per_s": 700e9,
+           "hbm_bytes": 16e9},
 }
 
 

@@ -1,0 +1,43 @@
+"""Roofline-driven offline autotuner (docs/tuning.md).
+
+The performance envelope of the stack — training bucket grids, the
+dedup capacity ladder, prefetch depth, serving ``token_budget`` /
+``max_rows_per_pack`` / micro-batch caps, the cascade rescue band — has
+been governed by hand-set config, while the compiled-program registry
+(telemetry/programs.py) measures FLOPs, bytes, HBM footprint and
+achieved MFU for every executable.  This package closes that loop
+offline:
+
+* :mod:`knobs` — the per-device-class candidate space (training and
+  serving knob grids);
+* :mod:`prune` — analytic feasibility through ``ProgramRegistry``
+  cost/memory analysis + the peak-spec table (HBM overflow,
+  compiled-program-count blowups) BEFORE a candidate costs a run;
+* :mod:`microbench` — short seeded in-process microbench runs (the
+  same primitives as ``BENCH_MICRO=train_step`` / ``serve``) scoring
+  the survivors;
+* :mod:`parity` — the mandatory gate: layout-only candidates must
+  reproduce a fixed probe set's scores bitwise (and loss trajectories
+  within the pinned step-parity tolerance); anything score-adjacent
+  goes through the ``bankops.evaluate_gate`` machinery.  Tuning can
+  change speed, never results;
+* :mod:`cascade` — the ``[cascade_low, cascade_high]`` band autotuner
+  (golden-set score distributions → target rescore rate), gated by
+  ``bankops.evaluate_cascade``;
+* :mod:`profile` — the versioned, sha256-manifested tuned profile per
+  device class that ``build.train_from_config`` /
+  ``build.serve_from_archive`` load by default (explicit config always
+  wins; unknown device class falls back to the shipped defaults);
+* :mod:`report` — the measured roofline table renderer
+  (docs/roofline_train.md's generated section);
+* :mod:`autotune` — the orchestration the ``python -m memvul_tpu
+  tune`` CLI drives.
+"""
+
+from .knobs import Candidate, serve_space, train_space  # noqa: F401
+from .profile import (  # noqa: F401
+    PROFILE_SCHEMA,
+    load_profile,
+    resolve_device_class,
+    save_profile,
+)

@@ -1,0 +1,351 @@
+"""Seeded in-process microbenches for tune candidates.
+
+One :class:`TuneBench` builds the expensive shared state ONCE — the
+synthetic workspace, the model, the initialized parameters, the probe
+texts and the anchor set — and then scores candidates with the same
+primitives the standalone ``BENCH_MICRO=train_step`` / ``serve``
+harnesses use (bench.py), just smaller and callable in-process:
+
+* :meth:`bench_train` — one warmup epoch (compiles) + one timed epoch
+  over the identical seeded pair stream per candidate, returning the
+  trainer's own epoch metrics (real/padded token throughput);
+* :meth:`bench_serve` — a closed-loop client pool over a fixed text
+  schedule through a :class:`ScoringService` built with the candidate's
+  dispatch knobs, returning rps + latency percentiles + the padding
+  ledger from the leg's private telemetry registry;
+* :meth:`probe_scores` / :meth:`train_losses` — the parity gate's
+  evidence: scores on a fixed probe set, and the per-step loss
+  trajectory (``step_loss_log``) for one short deterministic epoch.
+
+Everything is seeded (workspace seed, reader seed, PRNGKey(0)); two
+calls with the same knobs produce the same stream, which is what lets
+the parity gate demand bitwise equality for layout-only candidates.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue as _queue
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+# the fixed probe set size for parity evidence; small because every
+# serving candidate pays one probe pass through BOTH layouts
+DEFAULT_PROBE = 32
+
+
+class TuneBench:
+    """Shared microbench state + per-candidate runners.
+
+    ``model_size`` follows the bench harness contract: ``"tiny"``
+    exercises every code path off-TPU in seconds (the CPU harness
+    record), ``"base"`` is the geometry that means something on
+    hardware.
+    """
+
+    def __init__(
+        self,
+        *,
+        seed: int = 0,
+        model_size: str = "tiny",
+        seq_len: int = 128,
+        batch_size: int = 8,
+        grad_accum: int = 1,
+        steps_per_epoch: int = 4,
+        reports_per_project: int = 48,
+        n_requests: int = 96,
+        n_clients: int = 4,
+        max_batch: int = 8,
+        probe_size: int = DEFAULT_PROBE,
+        workdir: Optional[str] = None,
+    ) -> None:
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from ..data.readers import MemoryReader
+        from ..data.synthetic import build_workspace
+        from ..models import BertConfig, MemoryModel
+
+        self.seed = int(seed)
+        self.model_size = model_size
+        self.batch_size = int(batch_size)
+        self.grad_accum = int(grad_accum)
+        self.steps_per_epoch = int(steps_per_epoch)
+        self.n_requests = int(n_requests)
+        self.n_clients = int(n_clients)
+        self.max_batch = int(max_batch)
+        self._workdir = workdir or tempfile.mkdtemp(prefix="memvul-tune-")
+        self.workspace = build_workspace(
+            self._workdir, seed=self.seed, num_projects=8,
+            reports_per_project=int(reports_per_project),
+            realistic_lengths=True,
+        )
+        if model_size == "tiny":
+            cfg = BertConfig.tiny(
+                vocab_size=self.workspace["tokenizer"].vocab_size
+            )
+            seq_len = min(int(seq_len), cfg.max_position_embeddings)
+        else:
+            cfg = BertConfig.base(
+                vocab_size=max(30522, self.workspace["tokenizer"].vocab_size),
+                dtype=jnp.bfloat16,
+            )
+            if int(seq_len) > cfg.max_position_embeddings:
+                cfg = cfg.replace(max_position_embeddings=int(seq_len))
+        self.seq_len = int(seq_len)
+        self.buckets = tuple(
+            b for b in (64, 128, 256, 512) if b <= self.seq_len
+        ) or (self.seq_len,)
+        self.model = MemoryModel(cfg)
+        dummy = {
+            "input_ids": np.zeros((2, 8), np.int32),
+            "attention_mask": np.ones((2, 8), np.int32),
+        }
+        self.params = self.model.init(jax.random.PRNGKey(0), dummy, dummy)
+
+        reader = MemoryReader(
+            cve_path=self.workspace["paths"]["cve"],
+            anchor_path=self.workspace["paths"]["anchors"],
+        )
+        instances = list(
+            reader.read(self.workspace["paths"]["test"], split="test")
+        )
+        # the labeled golden set: the cascade band chooser and any
+        # cross-impl evaluate_gate check score these, metas included
+        self.golden_instances: List[Dict[str, Any]] = instances
+        texts = [inst["text1"] for inst in instances]
+        while len(texts) < max(self.n_requests, probe_size):
+            texts = texts + texts
+        self.texts: List[str] = texts[: self.n_requests]
+        self.probe_texts: List[str] = texts[: int(probe_size)]
+        base_anchors = list(self.workspace["anchors"].items())
+        self.anchor_instances = [
+            {
+                "text1": base_anchors[i % len(base_anchors)][1],
+                "meta": {
+                    "label": f"{base_anchors[i % len(base_anchors)][0]}#{i}",
+                    "type": "golden",
+                },
+            }
+            for i in range(33)
+        ]
+
+    # -- training ---------------------------------------------------------------
+
+    def _make_trainer(self, knobs: Dict[str, Any],
+                      step_loss_log: Optional[str] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..data.readers import MemoryReader
+        from ..training.trainer import MemoryTrainer, TrainerConfig
+
+        reader = MemoryReader(
+            cve_path=self.workspace["paths"]["cve"],
+            anchor_path=self.workspace["paths"]["anchors"],
+            sample_neg=0.5, seed=2021,
+        )
+        cfg_kw = {
+            k: knobs[k]
+            for k in ("train_buckets", "dedup_anchors", "prefetch_depth")
+            if k in knobs
+        }
+        return MemoryTrainer(
+            self.model,
+            # fresh buffers per candidate: the jitted step DONATES
+            # params/opt-state, so reusing one pytree across candidates
+            # would hand the next run already-deleted arrays
+            jax.tree_util.tree_map(jnp.array, self.params),
+            self.workspace["tokenizer"], reader,
+            train_path=self.workspace["paths"]["train"],
+            config=TrainerConfig(
+                batch_size=self.batch_size, grad_accum=self.grad_accum,
+                max_length=self.seq_len,
+                steps_per_epoch=self.steps_per_epoch, num_epochs=1,
+                warmup_steps=1, serialization_dir=None,
+                step_loss_log=step_loss_log,
+                **cfg_kw,
+            ),
+        )
+
+    def bench_train(self, knobs: Dict[str, Any],
+                    with_losses: bool = False) -> Dict[str, Any]:
+        """Warmup epoch (compiles every stack shape) + one timed epoch
+        over the identical epoch-0 stream, per the train_step harness
+        contract.  ``with_losses=True`` also returns the WARMUP epoch's
+        per-step loss trajectory (the parity gate's training evidence —
+        epoch 0 from fresh params, the same stream every candidate
+        sees) without paying a third epoch."""
+        log_path = self._loss_log_path(knobs) if with_losses else None
+        trainer = self._make_trainer(knobs, step_loss_log=log_path)
+        trainer.train_epoch()  # warmup: compiles
+        m = trainer.train_epoch()  # timed: same epoch-0 stream
+        out = {
+            "epoch_s": round(m["epoch_seconds"], 4),
+            "steps": m["num_steps"],
+            "padded_tokens": m["padded_tokens"],
+            "real_tokens": m["real_tokens"],
+            "padded_tokens_per_s": round(m["tokens_per_sec"], 1),
+            "real_tokens_per_s": round(m["real_tokens_per_sec"], 1),
+            "compiled_step_shapes": trainer.train_trace_count,
+        }
+        if log_path is not None:
+            # the log holds both epochs; epoch 0 (fresh params, the
+            # parity trajectory) is the first num_steps entries
+            out["losses"] = self._read_losses(log_path)[: m["num_steps"]]
+        return out
+
+    def _loss_log_path(self, knobs: Dict[str, Any]) -> str:
+        import hashlib
+
+        digest = hashlib.sha256(
+            json.dumps(knobs, sort_keys=True, default=str).encode()
+        ).hexdigest()[:12]
+        log_path = os.path.join(self._workdir, f"losses-{digest}.jsonl")
+        if os.path.exists(log_path):
+            os.unlink(log_path)
+        return log_path
+
+    @staticmethod
+    def _read_losses(log_path: str) -> List[float]:
+        with open(log_path) as fh:
+            return [json.loads(line)["loss"] for line in fh if line.strip()]
+
+    def train_losses(self, knobs: Dict[str, Any]) -> List[float]:
+        """The per-step loss trajectory of ONE deterministic epoch —
+        the training side of the parity gate's probe evidence, when the
+        caller wants it without a full bench."""
+        log_path = self._loss_log_path(knobs)
+        trainer = self._make_trainer(knobs, step_loss_log=log_path)
+        trainer.train_epoch()
+        return self._read_losses(log_path)
+
+    # -- serving ----------------------------------------------------------------
+
+    def build_predictor(self, knobs: Dict[str, Any], *,
+                        encoder_precision: str = "fp32"):
+        """A :class:`SiamesePredictor` wired for one serve candidate,
+        anchors encoded (so it is immediately scoreable)."""
+        from ..evaluate.predict_memory import SiamesePredictor
+
+        impl = knobs.get("score_impl", "bucketed")
+        kwargs: Dict[str, Any] = {}
+        if impl in ("ragged", "continuous"):
+            kwargs = dict(
+                score_impl=impl,
+                token_budget=int(
+                    knobs.get("token_budget") or 4 * self.seq_len
+                ),
+                max_rows_per_pack=int(
+                    knobs.get("max_rows_per_pack")
+                    or knobs.get("max_batch", self.max_batch)
+                ),
+            )
+        elif impl == "cascade":
+            kwargs = dict(
+                score_impl="cascade", encoder_precision="int8",
+                cascade_low=float(knobs.get("cascade_low", 0.3)),
+                cascade_high=float(knobs.get("cascade_high", 0.7)),
+            )
+        if encoder_precision != "fp32" and "encoder_precision" not in kwargs:
+            kwargs["encoder_precision"] = encoder_precision
+        predictor = SiamesePredictor(
+            self.model, self.params, self.workspace["tokenizer"],
+            batch_size=int(knobs.get("max_batch", self.max_batch)),
+            max_length=self.seq_len, buckets=self.buckets,
+            **kwargs,
+        )
+        predictor.encode_anchors(self.anchor_instances)
+        return predictor
+
+    def bench_serve(self, knobs: Dict[str, Any]) -> Dict[str, Any]:
+        """One closed-loop leg (the serve harness's ``_drive_leg``
+        shape): ``n_clients`` threads drain a shared queue of the fixed
+        text schedule through an :class:`InprocessClient`, deadlines
+        off.  Returns rps, latency percentiles, and the padding
+        ledger from the leg's own registry."""
+        import numpy as np
+
+        from ..serving import InprocessClient, ScoringService, ServiceConfig
+        from ..telemetry.registry import TelemetryRegistry
+
+        registry = TelemetryRegistry(enabled=True)
+        predictor = self.build_predictor(knobs)
+        max_batch = int(knobs.get("max_batch", self.max_batch))
+        service = ScoringService(
+            predictor,
+            config=ServiceConfig(
+                max_batch=max_batch,
+                max_wait_ms=float(knobs.get("max_wait_ms", 5.0)),
+                max_queue=max(256, 2 * self.n_clients * max_batch),
+                default_deadline_ms=0.0,
+            ),
+            registry=registry,
+        )
+        client = InprocessClient(service)
+        work: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        for text in self.texts:
+            work.put(text)
+        latencies: List[float] = []
+        lat_lock = threading.Lock()
+        errors = [0]
+
+        def _client_loop() -> None:
+            own: List[float] = []
+            while True:
+                try:
+                    text = work.get_nowait()
+                except _queue.Empty:
+                    break
+                t0 = time.perf_counter()
+                resp = client.score(text, deadline_ms=0)
+                own.append(time.perf_counter() - t0)
+                if resp["status"] != "ok":
+                    errors[0] += 1
+            with lat_lock:
+                latencies.extend(own)
+
+        client.score(self.texts[0], deadline_ms=0)  # warmup trickle
+        threads = [
+            threading.Thread(target=_client_loop, daemon=True)
+            for _ in range(self.n_clients)
+        ]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        service.drain()
+        counters = registry.snapshot()["counters"]
+        lat_ms = np.sort(np.asarray(latencies)) * 1e3
+        pct = (
+            lambda q: round(float(np.percentile(lat_ms, q)), 3)
+            if len(lat_ms) else None
+        )
+        return {
+            "requests_per_sec": round(len(self.texts) / elapsed, 1),
+            "latency_ms": {"p50": pct(50), "p95": pct(95), "p99": pct(99)},
+            "errors": errors[0],
+            "real_tokens": int(counters.get("serve.tokens_real", 0)),
+            "padded_tokens": int(counters.get("serve.tokens_padded", 0)),
+        }
+
+    def probe_scores(self, knobs: Dict[str, Any], *,
+                     impl: Optional[str] = None):
+        """Scores of the fixed probe set through one candidate's
+        predictor — the serving side of the parity gate's evidence.
+        ``impl`` passes through to ``score_texts`` (``"int8"`` is the
+        cascade band chooser's distribution input)."""
+        predictor = self.build_predictor(
+            knobs,
+            encoder_precision="int8" if impl in ("int8", "cascade") else "fp32",
+        )
+        return predictor.score_texts(self.probe_texts, impl=impl)

@@ -702,7 +702,7 @@ def test_cli_help_names_every_registered_subcommand(capsys):
     assert {
         "train", "evaluate", "serve", "pretrain", "baseline", "build-data",
         "analyze", "bench", "bank", "telemetry-report", "doctor", "parity",
-        "selfcheck", "lint", "score-corpus",
+        "selfcheck", "lint", "score-corpus", "tune",
     } <= names
     # every subcommand carries a non-empty one-line help
     helps = {ca.dest: ca.help for ca in sub._choices_actions}
@@ -739,6 +739,19 @@ def test_cli_help_names_every_registered_subcommand(capsys):
         "--select", "--json", "--baseline", "--no-baseline",
         "--write-baseline", "--list-codes",
     } <= lint_flags
+    # the tune subcommand's flag surface is pinned (docs/tuning.md):
+    # the sweep controls, the report path, and the unknown-device
+    # escape hatch are all part of the offline-autotuner contract
+    tune_flags = {
+        flag
+        for action in sub.choices["tune"]._actions
+        for flag in action.option_strings
+    }
+    assert {
+        "--mode", "--out", "--cascade", "--target-rescore-rate",
+        "--report", "--splice", "--device-class", "--allow-unknown-device",
+        "--max-programs", "--hbm-fraction", "--full-space",
+    } <= tune_flags
     # telemetry-report's machine-readable output flag (PR 10) is pinned
     # the same way: bench/CI consume it, so it cannot silently vanish
     report_flags = {

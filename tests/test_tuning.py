@@ -1,0 +1,531 @@
+"""Autotuner unit coverage: profile lifecycle, knob space, analytic
+pruning, the mandatory parity gate, and the report renderer.
+
+Everything here is deliberately jax-light (the profile store, parity
+checks, prune math and markdown rendering are pure Python) so the whole
+file rides tier-1; the end-to-end sweep is exercised by the
+``BENCH_MICRO=tune`` harness leg instead (docs/tuning.md).
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from memvul_tpu.tuning.knobs import Candidate, serve_space, train_space
+from memvul_tpu.tuning.parity import (
+    LOSS_TOL,
+    check_serve_parity,
+    check_train_parity,
+)
+from memvul_tpu.tuning.profile import (
+    PROFILE_SCHEMA,
+    apply_tuned_serving,
+    apply_tuned_trainer,
+    load_profile,
+    normalize_device_class,
+    profile_root,
+    resolve_device_class,
+    save_profile,
+)
+from memvul_tpu.tuning.prune import (
+    estimate_train_programs,
+    measured_hbm_baseline,
+    prune_candidates,
+    survivors,
+)
+from memvul_tpu.tuning.report import (
+    BEGIN_MARK,
+    END_MARK,
+    roofline_markdown,
+    splice_generated_section,
+)
+
+PROFILE_LOGGER = "memvul_tpu.tuning.profile"
+
+
+# ---------------------------------------------------------------------------
+# profile lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_profile_round_trip_with_sha256_manifest(tmp_path):
+    """save → load round-trip; the manifest carries the sha256 of the
+    exact document text and load verifies it."""
+    import hashlib
+
+    profile = {"train": {"train_buckets": "pow2", "prefetch_depth": 8},
+               "serving": {"max_batch": 8}}
+    doc_path = save_profile(tmp_path, "TPU v5 lite", profile)
+    assert doc_path.name == "profile-0001.json"
+    assert doc_path.parent.name == "tpu_v5_lite"
+
+    manifest = json.loads((doc_path.parent / "MANIFEST.json").read_text())
+    assert manifest["active"] == "profile-0001.json"
+    assert manifest["version"] == 1
+    assert manifest["schema"] == PROFILE_SCHEMA
+    text = doc_path.read_text()
+    assert manifest["sha256"] == hashlib.sha256(
+        text.encode("utf-8")).hexdigest()
+
+    loaded = load_profile(tmp_path, "TPU v5 lite")
+    assert loaded is not None
+    assert loaded["train"] == profile["train"]
+    assert loaded["serving"] == profile["serving"]
+    assert loaded["schema"] == PROFILE_SCHEMA
+    assert loaded["device_class"] == "tpu_v5_lite"
+    assert loaded["version"] == 1
+
+
+def test_profile_versions_advance_and_manifest_points_at_latest(tmp_path):
+    save_profile(tmp_path, "cpu", {"train": {"prefetch_depth": 2}})
+    p2 = save_profile(tmp_path, "cpu", {"train": {"prefetch_depth": 16}})
+    assert p2.name == "profile-0002.json"
+    # both documents remain on disk (immutable history), manifest points
+    # at the latest
+    assert (p2.parent / "profile-0001.json").is_file()
+    loaded = load_profile(tmp_path, "cpu")
+    assert loaded["version"] == 2
+    assert loaded["train"]["prefetch_depth"] == 16
+
+
+def test_save_recovers_from_torn_manifest(tmp_path):
+    """A garbage MANIFEST.json must not wedge the writer: the next save
+    restarts numbering above the highest on-disk document."""
+    save_profile(tmp_path, "cpu", {"train": {}})
+    save_profile(tmp_path, "cpu", {"train": {}})
+    (tmp_path / "cpu" / "MANIFEST.json").write_text("{torn")
+    p3 = save_profile(tmp_path, "cpu", {"train": {"prefetch_depth": 4}})
+    assert p3.name == "profile-0003.json"
+    assert load_profile(tmp_path, "cpu")["version"] == 3
+
+
+def test_corrupted_profile_falls_back_with_one_warning(tmp_path, caplog):
+    """Checksum mismatch → defaults (None) with exactly ONE warning for
+    the path, no matter how many replicas load through it."""
+    doc_path = save_profile(tmp_path, "cpu", {"train": {"prefetch_depth": 2}})
+    doc_path.write_text(doc_path.read_text().replace("2", "9"))
+    with caplog.at_level(logging.WARNING, logger=PROFILE_LOGGER):
+        assert load_profile(tmp_path, "cpu") is None
+        assert load_profile(tmp_path, "cpu") is None  # second replica
+    warnings = [r for r in caplog.records
+                if "sha256 mismatch" in r.getMessage()]
+    assert len(warnings) == 1
+    assert "falling back to defaults" in warnings[0].getMessage()
+
+
+def test_stale_schema_profile_falls_back_with_warning(tmp_path, caplog):
+    from memvul_tpu.resilience.io import atomic_write_text
+
+    doc_path = save_profile(tmp_path, "v6e", {"train": {}})
+    document = json.loads(doc_path.read_text())
+    document["schema"] = PROFILE_SCHEMA + 1
+    text = json.dumps(document, indent=2, sort_keys=True)
+    atomic_write_text(doc_path, text)
+    # keep the checksum valid so the failure is attributed to the
+    # schema, not the sha
+    import hashlib
+    manifest_path = doc_path.parent / "MANIFEST.json"
+    manifest = json.loads(manifest_path.read_text())
+    manifest["sha256"] = hashlib.sha256(text.encode("utf-8")).hexdigest()
+    atomic_write_text(manifest_path, json.dumps(manifest))
+    with caplog.at_level(logging.WARNING, logger=PROFILE_LOGGER):
+        assert load_profile(tmp_path, "v6e") is None
+    assert any("stale schema" in r.getMessage() for r in caplog.records)
+
+
+def test_untuned_class_and_no_root_are_silent(tmp_path, caplog):
+    """No manifest for a class (or no root configured at all) is the
+    normal zero-config state — None without any warning."""
+    with caplog.at_level(logging.WARNING, logger=PROFILE_LOGGER):
+        assert load_profile(None, "cpu") is None
+        assert load_profile(tmp_path, "never_tuned") is None
+    assert not caplog.records
+
+
+def test_profile_root_precedence(tmp_path, monkeypatch):
+    monkeypatch.delenv("MEMVUL_TUNED_PROFILES", raising=False)
+    assert profile_root(None) is None
+    monkeypatch.setenv("MEMVUL_TUNED_PROFILES", str(tmp_path / "env"))
+    assert profile_root(None) == tmp_path / "env"
+    # explicit config wins over the env
+    assert profile_root(tmp_path / "cfg") == tmp_path / "cfg"
+
+
+def test_normalize_and_resolve_device_class():
+    assert normalize_device_class("TPU v5 lite") == "tpu_v5_lite"
+    assert normalize_device_class("TPU v5p") == "tpu_v5p"
+    assert normalize_device_class("") == "unknown"
+    cls, peak = resolve_device_class("TPU v5 lite")
+    assert cls == "tpu_v5_lite"
+    assert peak is not None and peak["hbm_bytes"] == 16e9
+    cls, peak = resolve_device_class("grace-hopper")
+    assert cls == "grace_hopper"
+    assert peak is None
+
+
+# ---------------------------------------------------------------------------
+# explicit-config-wins precedence
+# ---------------------------------------------------------------------------
+
+
+def _tuned_config(tmp_path, device_class="cpu"):
+    return {"tuning": {"profile_dir": str(tmp_path),
+                       "device_class": device_class}}
+
+
+def test_apply_tuned_trainer_fills_only_absent_keys(tmp_path):
+    save_profile(tmp_path, "cpu", {"train": {
+        "train_buckets": "pow2", "dedup_anchors": True, "prefetch_depth": 16,
+        "not_a_trainer_knob": 1,  # must not be smuggled through
+    }})
+    config = _tuned_config(tmp_path)
+    trainer_cfg = {"train_buckets": None, "batch_size": 4}
+    out = apply_tuned_trainer(trainer_cfg, config)
+    # the user's explicit pad-to-max survives untouched
+    assert out["train_buckets"] is None
+    # absent knobs take the tuned values; unknown keys are dropped
+    assert out["dedup_anchors"] is True
+    assert out["prefetch_depth"] == 16
+    assert "not_a_trainer_knob" not in out
+    assert out["batch_size"] == 4
+
+
+def test_apply_tuned_trainer_no_profile_is_identity(tmp_path):
+    config = _tuned_config(tmp_path / "empty")
+    trainer_cfg = {"batch_size": 4}
+    assert apply_tuned_trainer(dict(trainer_cfg), config) == trainer_cfg
+
+
+def test_apply_tuned_trainer_respects_enabled_false(tmp_path):
+    save_profile(tmp_path, "cpu", {"train": {"prefetch_depth": 16}})
+    config = _tuned_config(tmp_path)
+    config["tuning"]["enabled"] = False
+    assert apply_tuned_trainer({}, config) == {}
+
+
+def test_apply_tuned_serving_explicit_non_null_key_wins(tmp_path):
+    save_profile(tmp_path, "cpu", {"serving": {
+        "score_impl": "ragged", "max_batch": 4, "token_budget": 2048,
+    }})
+    config = _tuned_config(tmp_path)
+    # serve_cfg is the defaults-merged view; explicitness is judged on
+    # the RAW archive section — a null there means "defaulted", not
+    # "user chose null"
+    explicit_section = {"max_batch": 32, "score_impl": None}
+    serve_cfg = {"score_impl": "bucketed", "max_batch": 32,
+                 "token_budget": None}
+    out = apply_tuned_serving(serve_cfg, explicit_section, config)
+    assert out["max_batch"] == 32        # explicitly written → wins
+    assert out["score_impl"] == "ragged"  # null in section → tuned fills
+    assert out["token_budget"] == 2048
+
+
+# ---------------------------------------------------------------------------
+# mandatory parity gate
+# ---------------------------------------------------------------------------
+
+CAND = Candidate(kind="serve", name="serve:test", knobs={})
+TRAIN_CAND = Candidate(kind="train", name="train:test", knobs={})
+
+
+def test_serve_parity_requires_bitwise_equality():
+    scores = np.array([[0.25, 0.75], [0.9, 0.1]], dtype=np.float32)
+    ok = check_serve_parity(CAND, scores, scores.copy())
+    assert ok.passed and ok.max_abs_delta == 0.0
+
+    drifted = scores.copy()
+    drifted[0, 0] += np.float32(1e-7)  # "close enough" is not parity
+    bad = check_serve_parity(CAND, scores, drifted)
+    assert not bad.passed
+    assert bad.reasons[0]["code"] == "parity_score_mismatch"
+    assert bad.reasons[0]["limit"] == 0.0
+    assert bad.max_abs_delta == pytest.approx(1e-7, rel=0.5)
+
+
+def test_serve_parity_shape_mismatch_refuses():
+    v = check_serve_parity(CAND, np.zeros((4, 2)), np.zeros((3, 2)))
+    assert not v.passed
+    assert v.reasons[0]["code"] == "parity_score_mismatch"
+
+
+def test_train_parity_tolerance_and_refusals():
+    base = [2.0, 1.5, 1.2, 1.0]
+    within = [x + LOSS_TOL / 2 for x in base]
+    assert check_train_parity(TRAIN_CAND, base, within).passed
+
+    diverged = list(base)
+    diverged[-1] += 10 * LOSS_TOL
+    v = check_train_parity(TRAIN_CAND, base, diverged)
+    assert not v.passed
+    assert v.reasons[0]["code"] == "parity_loss_divergence"
+    assert v.reasons[0]["limit"] == LOSS_TOL
+
+    v = check_train_parity(TRAIN_CAND, base, base[:-1])
+    assert not v.passed and v.reasons[0]["code"] == "parity_step_count"
+
+    v = check_train_parity(TRAIN_CAND, [], [])
+    assert not v.passed and v.reasons[0]["code"] == "parity_no_evidence"
+
+
+def test_parity_verdict_serializes():
+    v = check_serve_parity(CAND, np.ones(3), np.zeros(3))
+    payload = json.loads(json.dumps(v.to_json()))
+    assert payload["candidate"]["name"] == "serve:test"
+    assert payload["passed"] is False
+
+
+# ---------------------------------------------------------------------------
+# knob space
+# ---------------------------------------------------------------------------
+
+
+def test_train_space_shape_and_dedup_noop_collapse():
+    cands = train_space(max_length=512, batch_size=32)
+    names = [c.name for c in cands]
+    assert len(names) == len(set(names))
+    # pad-to-max (None) emits one row per prefetch depth — dedup is a
+    # no-op without buckets, so no dedup=1 variant exists there
+    padmax = [c for c in cands if c.knobs["train_buckets"] is None]
+    assert len(padmax) == 3
+    assert all(c.knobs["dedup_anchors"] is False for c in padmax)
+    # 3 grids × (dedup axis collapses for None) × 3 depths
+    assert len(cands) == 15
+
+
+def test_serve_space_shape_and_unknown_impl():
+    cands = serve_space(max_length=512, max_batch=16)
+    assert len(cands) == 18
+    impls = {c.knobs["score_impl"] for c in cands}
+    assert impls == {"bucketed", "ragged", "continuous"}
+    packed = [c for c in cands if c.knobs["score_impl"] != "bucketed"]
+    assert all("token_budget" in c.knobs and "max_rows_per_pack" in c.knobs
+               for c in packed)
+    # the cascade band is score-adjacent and never swept here
+    assert all("cascade_low" not in c.knobs for c in cands)
+    with pytest.raises(ValueError, match="unknown impl"):
+        serve_space(impls=("bucketed", "flash"))
+
+
+# ---------------------------------------------------------------------------
+# analytic pruning
+# ---------------------------------------------------------------------------
+
+
+class _StubRegistry:
+    """Quacks like ProgramRegistry.snapshot() for measured_hbm_baseline."""
+
+    def __init__(self, rows):
+        self._rows = rows
+
+    def snapshot(self):
+        return list(self._rows)
+
+
+def test_estimate_train_programs():
+    # pad-to-max is a single step signature
+    assert estimate_train_programs(None, True, 32, 512) == 1
+    # an explicit 4-boundary grid: 16 cells, dedup multiplies by the
+    # capacity ladder
+    from memvul_tpu.data.batching import dedup_capacities
+
+    grid = [64, 128, 256, 512]
+    assert estimate_train_programs(grid, False, 32, 512) == 16
+    ladder = len(dedup_capacities(32))
+    assert estimate_train_programs(grid, True, 32, 512) == 16 * ladder
+
+
+def test_prune_refuses_program_count_blowup():
+    cands = train_space(max_length=512, batch_size=32)
+    decisions = prune_candidates(cands, batch_size=32, max_length=512,
+                                 max_programs=4)
+    refused = [d for d in decisions if not d.feasible]
+    assert refused, "a dedup'd grid must blow a 4-program ceiling"
+    for d in refused:
+        assert d.reasons[0]["code"] == "program_count_blowup"
+        assert d.reasons[0]["observed"] > 4
+        assert d.reasons[0]["limit"] == 4
+    # pad-to-max (1 program) always survives
+    assert any(c.knobs.get("train_buckets") is None
+               for c in survivors(decisions))
+
+
+def test_prune_refuses_hbm_overflow_with_measured_evidence():
+    # measured footprint 10 GB at the baseline serve shape
+    # (max_batch=16 × 512 tokens); doubling the micro-batch cap (or a
+    # 32×L token budget = 2× the baseline padded tokens) projects to
+    # 20 GB > 90% of a 16 GB part
+    registry = _StubRegistry([
+        {"key": "serve_step", "hbm_bytes": 10e9},
+        {"key": "tiny", "hbm_bytes": 1e9},
+    ])
+    assert measured_hbm_baseline(registry)["hbm_bytes"] == 10e9
+    cands = serve_space(max_length=512, max_batch=16,
+                        budget_factors=(2, 32), rows_factors=(1,))
+    decisions = prune_candidates(
+        cands, max_length=512, max_batch=16,
+        peak={"hbm_bytes": 16e9}, registry=registry, hbm_fraction=0.9,
+    )
+    by_name = {d.candidate.name: d for d in decisions}
+    big = by_name["serve:ragged,budget=32xL,rows=16"]
+    assert not big.feasible
+    assert big.reasons[0]["code"] == "hbm_overflow"
+    assert big.estimated_hbm_bytes == pytest.approx(20e9)
+    double_cap = by_name["serve:bucketed,max_batch=32,wait_ms=2"]
+    assert not double_cap.feasible
+    assert double_cap.reasons[0]["code"] == "hbm_overflow"
+    # a 2×L budget is a quarter of the baseline footprint and survives,
+    # as does the half-cap bucketed candidate
+    assert by_name["serve:ragged,budget=2xL,rows=16"].feasible
+    assert by_name["serve:bucketed,max_batch=8,wait_ms=2"].feasible
+
+
+def test_prune_is_honest_when_it_cannot_measure():
+    """No peak spec / no measured footprint → the HBM check records a
+    note and skips — it never prunes against numbers that don't exist."""
+    cands = serve_space(max_length=512, max_batch=16)
+    no_peak = prune_candidates(cands, peak=None,
+                               registry=_StubRegistry([]))
+    assert all(d.feasible for d in no_peak)
+    assert all("hbm_check_skipped:no_peak_spec" in d.notes for d in no_peak)
+
+    no_measured = prune_candidates(cands, peak={"hbm_bytes": 16e9},
+                                   registry=_StubRegistry([]))
+    assert all(d.feasible for d in no_measured)
+    assert all("hbm_check_skipped:no_measured_footprint" in d.notes
+               for d in no_measured)
+    # decisions serialize for the tune report
+    json.dumps([d.to_json() for d in no_peak])
+
+
+def test_unknown_device_refusal_is_machine_readable():
+    from memvul_tpu.telemetry.programs import PEAK_SPECS
+    from memvul_tpu.tuning.autotune import unknown_device_refusal
+
+    refusal = unknown_device_refusal("grace_hopper")
+    assert refusal["error"] == "unknown_device_class"
+    assert refusal["device_class"] == "grace_hopper"
+    assert refusal["known_markers"] == sorted(PEAK_SPECS)
+    assert "allow-unknown-device" in refusal["hint"]
+    json.dumps(refusal)
+
+
+# ---------------------------------------------------------------------------
+# cascade band math (gate-free slice; the gated path runs in the bench leg)
+# ---------------------------------------------------------------------------
+
+
+def test_choose_band_covers_nearest_fraction_and_threshold(monkeypatch):
+    """The band must cover exactly the target fraction of rows nearest
+    the decision threshold, widened to include the threshold itself."""
+    import importlib
+
+    # bankops re-exports a `promote` *function*, which shadows the
+    # submodule on attribute import — go through importlib
+    promote_mod = importlib.import_module("memvul_tpu.bankops.promote")
+    from memvul_tpu.tuning.cascade import choose_band
+
+    scores = np.array([0.05, 0.1, 0.2, 0.45, 0.48, 0.52, 0.8, 0.9, 0.95,
+                       0.99])
+
+    class _FakePredictor:
+        cascade_band = (0.3, 0.7)
+
+        def score_texts(self, texts, impl=None):
+            # one anchor column — choose_band's max(axis=-1) then sees
+            # exactly these scores
+            assert impl == "int8"
+            return scores[:, None]
+
+    class _FakeDecision:
+        approved = True
+
+        def to_json(self):
+            return {"approved": True}
+
+    instances = [{"text1": f"t{i}", "label": i % 2} for i in range(10)]
+    predictor = _FakePredictor()
+    calls = {}
+
+    def fake_evaluate(pred, insts, thresholds=None, threshold=0.5):
+        calls["band_during_gate"] = tuple(pred.cascade_band)
+        return _FakeDecision()
+
+    monkeypatch.setattr(promote_mod, "evaluate_cascade", fake_evaluate)
+    record = choose_band(predictor, instances, target_rescore_rate=0.3)
+
+    # 3 nearest-to-0.5 rows are 0.45, 0.48, 0.52 → band [0.45, 0.52]
+    assert record["cascade_low"] == pytest.approx(0.45)
+    assert record["cascade_high"] == pytest.approx(0.52)
+    assert record["predicted_rescore_rate"] == pytest.approx(0.3)
+    assert record["approved"] is True
+    # the gate saw the candidate band; the tuner restored the prior one
+    assert calls["band_during_gate"] == (0.45, 0.52)
+    assert predictor.cascade_band == (0.3, 0.7)
+
+
+def test_choose_band_rejects_bad_inputs():
+    from memvul_tpu.tuning.cascade import choose_band
+
+    with pytest.raises(ValueError, match="non-empty"):
+        choose_band(object(), [])
+    with pytest.raises(ValueError, match="target_rescore_rate"):
+        choose_band(object(), [{"text1": "x"}], target_rescore_rate=0.0)
+
+
+# ---------------------------------------------------------------------------
+# report renderer
+# ---------------------------------------------------------------------------
+
+SNAPSHOT = [
+    {"key": "train_step/b128", "invocations": 10, "flops": 2.5e12,
+     "bytes_accessed": 3.2e9, "hbm_bytes": 1.1e9, "device_time_s": 1.25,
+     "mfu": 0.31},
+    {"key": "encode/b64", "invocations": 4, "flops": 1.0e9,
+     "bytes_accessed": 2.0e6, "hbm_bytes": None, "device_time_s": 0.01,
+     "mfu": None},
+]
+ROOFLINE = {
+    "device_kind": "TPU v5 lite", "interpret_only": False,
+    "peak_flops_per_s": 197e12, "peak_bytes_per_s": 819e9,
+    "programs": 2, "flops_total": 2.5e12, "bytes_total": 3.2e9,
+    "device_time_s": 1.26, "achieved_flops_per_s": 1.98e12,
+    "achieved_bytes_per_s": 2.5e9, "mfu": 0.31, "membw_util": 0.003,
+}
+
+
+def test_roofline_markdown_renders_measured_rows():
+    md = roofline_markdown(SNAPSHOT, ROOFLINE)
+    assert md.startswith(BEGIN_MARK)
+    assert md.rstrip().endswith(END_MARK)
+    assert "`train_step/b128`" in md
+    assert "2.50 TFLOP/s" not in md  # peaks, not per-program, carry units
+    assert "197.00 TFLOP/s" in md
+    assert "31.0%" in md
+    # unmeasured cells render as em-dash, never as a fake zero
+    assert "| — |" in md
+
+
+def test_roofline_markdown_interpret_only_keeps_mfu_null():
+    md = roofline_markdown(
+        [{"key": "k", "invocations": 1, "flops": 1e9,
+          "bytes_accessed": 1e6, "device_time_s": 0.0, "mfu": None}],
+        {"device_kind": "cpu", "interpret_only": True},
+    )
+    assert "interpret-only" in md
+    assert "made-up peak" in md
+
+
+def test_splice_generated_section_replaces_and_appends():
+    generated = roofline_markdown(SNAPSHOT, ROOFLINE)
+    doc = f"# Roofline\n\nprose above\n\n{BEGIN_MARK}\nOLD\n{END_MARK}\n\nprose below\n"
+    out = splice_generated_section(doc, generated)
+    assert "OLD" not in out
+    assert "prose above" in out and "prose below" in out
+    assert out.count(BEGIN_MARK) == 1 and out.count(END_MARK) == 1
+
+    plain = "# Doc with no fence\n"
+    appended = splice_generated_section(plain, generated)
+    assert appended.startswith(plain)
+    assert BEGIN_MARK in appended
